@@ -1,0 +1,22 @@
+"""hydrabadger_tpu — a TPU-native HoneyBadger BFT consensus framework.
+
+A from-scratch re-design of the capabilities of VegeBun-csj/hydrabadger
+(an HBBFT peer-to-peer node in Rust/tokio) around TPU execution:
+
+- ``crypto``    — CPU-reference crypto: GF(2^8) Reed-Solomon erasure
+                  coding, BLS12-381 threshold signatures/encryption,
+                  synchronous DKG.  Pluggable ``CryptoEngine``.
+- ``ops``       — JAX/Pallas TPU kernels: batched GF(2^8) matmul (MXU
+                  bit-matmul), vmapped RS encode/decode, batched BLS ops.
+- ``consensus`` — pure sans-io protocol cores: Broadcast (RBC),
+                  BinaryAgreement, Subset (ACS), ThresholdSign/Decrypt,
+                  HoneyBadger, QueueingHoneyBadger, DynamicHoneyBadger.
+- ``sim``       — deterministic in-process multi-node simulator with
+                  adversary scheduling; the benchmark harness.
+- ``parallel``  — jax.sharding Mesh / shard_map scale-out of the sim.
+- ``net``       — asyncio TCP node runtime: signed wire protocol, peer
+                  lifecycle, event handler, the Hydrabadger public API.
+- ``utils``     — deterministic codec, ids, config.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,85 @@
+"""Dispatch layer between the C++ native library and the numpy fallback.
+
+The reference's GF(2^8) hot path is native (SIMD reed-solomon-erasure,
+SURVEY.md §2.2); ours is native/gf256_rs.cpp built to libgf256_rs.so.
+Python keeps the orchestration; the inner GF matmul drops to C++ when the
+shared library is present, else to vectorised numpy.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+import numpy as np
+
+from . import gf256
+
+_LIB = None
+
+
+def _find_lib():
+    override = os.environ.get("HYDRABADGER_TPU_NATIVE_LIB")
+    candidates = []
+    if override:
+        candidates.append(Path(override))
+    root = Path(__file__).resolve().parents[2]
+    candidates += [
+        root / "native" / "libgf256_rs.so",
+        Path(__file__).resolve().parent / "libgf256_rs.so",
+    ]
+    for c in candidates:
+        if c.exists():
+            return c
+    return None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = _find_lib()
+    if path is None:
+        _LIB = False
+        return False
+    try:
+        lib = ctypes.CDLL(str(path))
+        lib.gf256_matmul.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),  # a [m,k]
+            ctypes.POINTER(ctypes.c_uint8),  # b [k,n]
+            ctypes.POINTER(ctypes.c_uint8),  # out [m,n]
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.gf256_matmul.restype = None
+        _LIB = lib
+    except OSError:
+        _LIB = False
+    return _LIB
+
+
+def native_available() -> bool:
+    return bool(_load())
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """[m,k] x [k,n] GF(2^8) matmul; C++ when built, numpy otherwise."""
+    lib = _load()
+    if not lib:
+        return gf256.matmul(a, b)
+    a = np.ascontiguousarray(a, dtype=np.uint8)
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    out = np.empty((m, n), dtype=np.uint8)
+    lib.gf256_matmul(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        m,
+        k,
+        n,
+    )
+    return out

@@ -1,0 +1,170 @@
+"""Systematic Reed-Solomon erasure coding over GF(2^8).
+
+TPU-native framework equivalent of the `reed-solomon-erasure` crate used
+inside hbbft's Broadcast (reference: /root/reference/Cargo.toml:27-29 and
+SURVEY.md §2.2): a proposal is split into `data_shards` pieces, extended
+with `parity_shards` parity pieces, and any `data_shards` of the
+`data_shards + parity_shards` total reconstruct the original.
+
+Encoding matrix: Vandermonde V[n, k] normalised so the top k x k block is
+the identity (systematic).  This matches the crate's construction and
+guarantees every k x k submatrix is invertible.
+
+The heavy ops dispatch to the C++ native library (native/gf256_rs.cpp)
+when built, else vectorised numpy.  The batched TPU path lives in
+hydrabadger_tpu.ops.rs_jax and is tested bit-equal to this module.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import gf256
+from . import _native
+
+
+class ReedSolomonError(ValueError):
+    pass
+
+
+@lru_cache(maxsize=256)
+def encode_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """[n, k] systematic encode matrix: identity on top, parity rows below."""
+    n = data_shards + parity_shards
+    if data_shards <= 0 or parity_shards < 0:
+        raise ReedSolomonError("shard counts must be positive")
+    if n > 255:
+        raise ReedSolomonError("total shards must be <= 255 for GF(2^8)")
+    vm = gf256.vandermonde(n, data_shards)
+    top_inv = gf256.mat_inv(vm[:data_shards])
+    mat = gf256.matmul(vm, top_inv)
+    mat.flags.writeable = False
+    return mat
+
+
+@lru_cache(maxsize=256)
+def parity_bit_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """GF(2) bit-expansion of the parity rows — consumed by the TPU MXU path."""
+    m = encode_matrix(data_shards, parity_shards)[data_shards:]
+    out = gf256.expand_to_bit_matrix(m)
+    out.flags.writeable = False
+    return out
+
+
+class ReedSolomon:
+    """Erasure codec with the same contract as reed-solomon-erasure.
+
+    >>> rs = ReedSolomon(4, 2)
+    >>> shards = rs.encode_bytes(b"hello world!")
+    >>> rs.reconstruct_data([s if i not in (0, 5) else None
+    ...                      for i, s in enumerate(shards)])
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        self.data_shards = int(data_shards)
+        self.parity_shards = int(parity_shards)
+        self.total_shards = self.data_shards + self.parity_shards
+        self.matrix = encode_matrix(self.data_shards, self.parity_shards)
+
+    # -- encoding -----------------------------------------------------------
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data: [k, shard_len] uint8 -> [n, shard_len] (data rows + parity)."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if data.ndim != 2 or data.shape[0] != self.data_shards:
+            raise ReedSolomonError(
+                f"expected [{self.data_shards}, L] data, got {data.shape}"
+            )
+        parity = _native.gf_matmul(self.matrix[self.data_shards :], data)
+        return np.concatenate([data, parity], axis=0)
+
+    def encode_bytes(self, payload: bytes) -> list[bytes]:
+        """Pad + split a byte string into n shards (shard 0..k-1 carry data).
+
+        Layout mirrors hbbft broadcast: 4-byte big-endian length prefix, then
+        payload, zero-padded to a multiple of data_shards.
+        """
+        prefixed = len(payload).to_bytes(4, "big") + payload
+        shard_len = -(-len(prefixed) // self.data_shards)
+        padded = prefixed + b"\0" * (shard_len * self.data_shards - len(prefixed))
+        data = np.frombuffer(padded, dtype=np.uint8).reshape(
+            self.data_shards, shard_len
+        )
+        full = self.encode(data)
+        return [full[i].tobytes() for i in range(self.total_shards)]
+
+    # -- reconstruction -----------------------------------------------------
+
+    def reconstruct(
+        self, shards: Sequence[Optional[np.ndarray]], data_only: bool = False
+    ) -> list[np.ndarray]:
+        """Fill in missing (None) shards; needs >= data_shards present."""
+        if len(shards) != self.total_shards:
+            raise ReedSolomonError(
+                f"expected {self.total_shards} shard slots, got {len(shards)}"
+            )
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.data_shards:
+            raise ReedSolomonError(
+                f"need {self.data_shards} shards, have {len(present)}"
+            )
+        arrs = {}
+        shard_len = None
+        for i in present:
+            a = np.ascontiguousarray(shards[i], dtype=np.uint8)
+            if a.ndim != 1:
+                raise ReedSolomonError("shards must be 1-D uint8")
+            if shard_len is None:
+                shard_len = a.shape[0]
+            elif a.shape[0] != shard_len:
+                raise ReedSolomonError("shard length mismatch")
+            arrs[i] = a
+
+        out: list[Optional[np.ndarray]] = [
+            arrs.get(i) for i in range(self.total_shards)
+        ]
+        missing_data = [i for i in range(self.data_shards) if out[i] is None]
+        if missing_data:
+            rows = present[: self.data_shards]
+            sub = self.matrix[rows]
+            sub_inv = gf256.mat_inv(sub)
+            stacked = np.stack([arrs[i] for i in rows])  # [k, L]
+            decode_rows = sub_inv[missing_data]  # [miss, k]
+            recovered = _native.gf_matmul(decode_rows, stacked)
+            for row, i in enumerate(missing_data):
+                out[i] = recovered[row]
+        if not data_only:
+            missing_parity = [
+                i for i in range(self.data_shards, self.total_shards) if out[i] is None
+            ]
+            if missing_parity:
+                data = np.stack(out[: self.data_shards])
+                par_rows = self.matrix[missing_parity]
+                recovered = _native.gf_matmul(par_rows, data)
+                for row, i in enumerate(missing_parity):
+                    out[i] = recovered[row]
+        return [o for o in out if o is not None] if data_only else out  # type: ignore
+
+    def reconstruct_data(self, shards: Sequence[Optional[bytes]]) -> bytes:
+        """Recover the original byte payload from >= k shards (bytes or None)."""
+        as_arrays = [
+            np.frombuffer(s, dtype=np.uint8) if s is not None else None
+            for s in shards
+        ]
+        full = self.reconstruct(as_arrays)
+        joined = b"".join(full[i].tobytes() for i in range(self.data_shards))
+        length = int.from_bytes(joined[:4], "big")
+        if length > len(joined) - 4:
+            raise ReedSolomonError("corrupt length prefix")
+        return joined[4 : 4 + length]
+
+    def verify(self, shards: Sequence[np.ndarray]) -> bool:
+        """Check parity rows match the data rows."""
+        data = np.stack([np.asarray(s, dtype=np.uint8) for s in shards[: self.data_shards]])
+        parity = _native.gf_matmul(self.matrix[self.data_shards :], data)
+        got = np.stack(
+            [np.asarray(s, dtype=np.uint8) for s in shards[self.data_shards :]]
+        )
+        return bool(np.array_equal(parity, got))

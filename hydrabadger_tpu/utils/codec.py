@@ -1,0 +1,146 @@
+"""Deterministic binary codec for wire messages and signing.
+
+Plays the role bincode+serde plays in the reference wire protocol
+(/root/reference/src/lib.rs:400-437): every frame is serialised to a
+canonical byte string before BLS signing, so two engines (CPU / TPU,
+Python / C++) produce identical bytes for identical values — a hard
+requirement for signature verification (SURVEY.md §7 hard part 4).
+
+Self-describing tagged format, canonical by construction:
+  N            -> None
+  T / F        -> True / False
+  I <zigzag>   -> int (arbitrary precision, zigzag + LEB128)
+  B <len> ...  -> bytes
+  S <len> ...  -> str (UTF-8)
+  L <n> items  -> list / tuple (decoded as tuple)
+  D <n> k v..  -> dict, entries sorted by encoded key bytes
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def _write_uvarint(out: bytearray, n: int) -> None:
+    if n < 0:
+        raise ValueError("uvarint must be non-negative")
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_uvarint(buf: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _encode_into(out: bytearray, value: Any) -> None:
+    if value is None:
+        out.append(ord("N"))
+    elif value is True:
+        out.append(ord("T"))
+    elif value is False:
+        out.append(ord("F"))
+    elif isinstance(value, int):
+        out.append(ord("I"))
+        zz = (value << 1) if value >= 0 else ((-value << 1) - 1)
+        _write_uvarint(out, zz)
+    elif isinstance(value, (bytes, bytearray, memoryview)):
+        raw = bytes(value)
+        out.append(ord("B"))
+        _write_uvarint(out, len(raw))
+        out += raw
+    elif isinstance(value, str):
+        raw = value.encode("utf-8")
+        out.append(ord("S"))
+        _write_uvarint(out, len(raw))
+        out += raw
+    elif isinstance(value, (list, tuple)):
+        out.append(ord("L"))
+        _write_uvarint(out, len(value))
+        for item in value:
+            _encode_into(out, item)
+    elif isinstance(value, dict):
+        out.append(ord("D"))
+        _write_uvarint(out, len(value))
+        entries = []
+        for k, v in value.items():
+            kb = bytearray()
+            _encode_into(kb, k)
+            vb = bytearray()
+            _encode_into(vb, v)
+            entries.append((bytes(kb), bytes(vb)))
+        entries.sort(key=lambda e: e[0])
+        for kb, vb in entries:
+            out += kb
+            out += vb
+    else:
+        raise TypeError(f"codec cannot encode {type(value).__name__}")
+
+
+def encode(value: Any) -> bytes:
+    out = bytearray()
+    _encode_into(out, value)
+    return bytes(out)
+
+
+def _decode_at(buf: bytes, pos: int) -> tuple[Any, int]:
+    if pos >= len(buf):
+        raise ValueError("truncated value")
+    tag = buf[pos]
+    pos += 1
+    if tag == ord("N"):
+        return None, pos
+    if tag == ord("T"):
+        return True, pos
+    if tag == ord("F"):
+        return False, pos
+    if tag == ord("I"):
+        zz, pos = _read_uvarint(buf, pos)
+        return (zz >> 1) if not zz & 1 else -((zz + 1) >> 1), pos
+    if tag == ord("B"):
+        n, pos = _read_uvarint(buf, pos)
+        if pos + n > len(buf):
+            raise ValueError("truncated bytes")
+        return buf[pos : pos + n], pos + n
+    if tag == ord("S"):
+        n, pos = _read_uvarint(buf, pos)
+        if pos + n > len(buf):
+            raise ValueError("truncated str")
+        return buf[pos : pos + n].decode("utf-8"), pos + n
+    if tag == ord("L"):
+        n, pos = _read_uvarint(buf, pos)
+        items = []
+        for _ in range(n):
+            item, pos = _decode_at(buf, pos)
+            items.append(item)
+        return tuple(items), pos
+    if tag == ord("D"):
+        n, pos = _read_uvarint(buf, pos)
+        out = {}
+        for _ in range(n):
+            k, pos = _decode_at(buf, pos)
+            v, pos = _decode_at(buf, pos)
+            out[k] = v
+        return out, pos
+    raise ValueError(f"unknown tag byte {tag!r}")
+
+
+def decode(buf: bytes) -> Any:
+    value, pos = _decode_at(bytes(buf), 0)
+    if pos != len(buf):
+        raise ValueError(f"{len(buf) - pos} trailing bytes")
+    return value

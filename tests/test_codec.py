@@ -1,0 +1,65 @@
+"""Deterministic codec tests."""
+import pytest
+
+from hydrabadger_tpu.utils import codec
+from hydrabadger_tpu.utils.ids import Uid
+
+
+CASES = [
+    None,
+    True,
+    False,
+    0,
+    1,
+    -1,
+    2**100,
+    -(2**100),
+    b"",
+    b"\x00\xff" * 10,
+    "",
+    "héllo ⊕",
+    (),
+    (1, b"two", "three", None),
+    {"a": 1, "b": (2, 3)},
+    {b"k1": {b"nested": True}},
+    (((1,),),),
+]
+
+
+@pytest.mark.parametrize("value", CASES, ids=[repr(c)[:30] for c in CASES])
+def test_roundtrip(value):
+    assert codec.decode(codec.encode(value)) == value
+
+
+def test_lists_decode_as_tuples():
+    assert codec.decode(codec.encode([1, 2])) == (1, 2)
+
+
+def test_dict_order_canonical():
+    a = codec.encode({"x": 1, "y": 2})
+    b = codec.encode({"y": 2, "x": 1})
+    assert a == b
+
+
+def test_trailing_bytes_rejected():
+    with pytest.raises(ValueError):
+        codec.decode(codec.encode(1) + b"\x00")
+
+
+def test_truncation_rejected():
+    buf = codec.encode((1, b"hello", "world"))
+    for cut in range(1, len(buf)):
+        with pytest.raises(ValueError):
+            codec.decode(buf[:cut])
+
+
+def test_uid_roundtrip_via_bytes():
+    u = Uid()
+    enc = codec.encode(u.bytes)
+    assert Uid(codec.decode(enc)) == u
+
+
+def test_uid_ordering_and_hash():
+    a, b = Uid(b"\x00" * 16), Uid(b"\xff" * 16)
+    assert a < b
+    assert len({a, b, Uid(a.bytes)}) == 2

@@ -1,0 +1,105 @@
+"""GF(2^8) field axioms + table consistency."""
+import numpy as np
+import pytest
+
+from hydrabadger_tpu.crypto import gf256
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf256.EXP_TABLE[gf256.LOG_TABLE[a]] == a
+
+
+def test_mul_table_matches_polynomial_mul():
+    def poly_mul(a, b):
+        result = 0
+        while b:
+            if b & 1:
+                result ^= a
+            b >>= 1
+            a <<= 1
+            if a & 0x100:
+                a ^= gf256.POLY
+        return result
+
+    rng = np.random.default_rng(0)
+    for _ in range(500):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert gf256.MUL_TABLE[a, b] == poly_mul(a, b)
+
+
+def test_field_axioms():
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 256, 100).astype(np.uint8)
+    b = rng.integers(0, 256, 100).astype(np.uint8)
+    c = rng.integers(0, 256, 100).astype(np.uint8)
+    assert np.array_equal(gf256.mul(a, b), gf256.mul(b, a))
+    assert np.array_equal(
+        gf256.mul(a, gf256.mul(b, c)), gf256.mul(gf256.mul(a, b), c)
+    )
+    # distributivity
+    assert np.array_equal(
+        gf256.mul(a, gf256.add(b, c)),
+        gf256.add(gf256.mul(a, b), gf256.mul(a, c)),
+    )
+    # inverse
+    nz = a[a != 0]
+    assert np.all(gf256.mul(nz, gf256.inv(nz)) == 1)
+
+
+def test_matmul_identity_and_assoc():
+    rng = np.random.default_rng(2)
+    m = rng.integers(0, 256, (5, 7)).astype(np.uint8)
+    ident = np.eye(5, dtype=np.uint8)
+    assert np.array_equal(gf256.matmul(ident, m), m)
+    a = rng.integers(0, 256, (3, 4)).astype(np.uint8)
+    b = rng.integers(0, 256, (4, 5)).astype(np.uint8)
+    c = rng.integers(0, 256, (5, 6)).astype(np.uint8)
+    assert np.array_equal(
+        gf256.matmul(gf256.matmul(a, b), c), gf256.matmul(a, gf256.matmul(b, c))
+    )
+
+
+def test_mat_inv():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 5, 11):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                mi = gf256.mat_inv(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf256.matmul(m, mi), np.eye(n, dtype=np.uint8))
+        assert np.array_equal(gf256.matmul(mi, m), np.eye(n, dtype=np.uint8))
+
+
+def test_mat_inv_singular_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.mat_inv(m)
+
+
+def test_bit_matrix_of_const():
+    rng = np.random.default_rng(4)
+    for _ in range(50):
+        c, x = int(rng.integers(256)), int(rng.integers(256))
+        m = gf256.bit_matrix_of_const(c)
+        xbits = np.array([(x >> i) & 1 for i in range(8)], dtype=np.uint8)
+        ybits = (m @ xbits) % 2
+        y = int(sum(int(b) << i for i, b in enumerate(ybits)))
+        assert y == gf256.MUL_TABLE[c, x]
+
+
+def test_expand_to_bit_matrix_matches_gf_matmul():
+    rng = np.random.default_rng(5)
+    a = rng.integers(0, 256, (3, 4)).astype(np.uint8)
+    x = rng.integers(0, 256, (4, 9)).astype(np.uint8)
+    bits_a = gf256.expand_to_bit_matrix(a)  # [24, 32]
+    # expand x to bits: [32, 9]
+    xbits = np.unpackbits(x[:, None, :], axis=1, bitorder="little").reshape(4 * 8, 9)
+    ybits = (bits_a.astype(np.int32) @ xbits.astype(np.int32)) % 2
+    y = np.packbits(
+        ybits.astype(np.uint8).reshape(3, 8, 9), axis=1, bitorder="little"
+    ).reshape(3, 9)
+    assert np.array_equal(y, gf256.matmul(a, x))

@@ -1,0 +1,86 @@
+"""Reed-Solomon erasure codec tests."""
+import itertools
+
+import numpy as np
+import pytest
+
+from hydrabadger_tpu.crypto import gf256
+from hydrabadger_tpu.crypto.rs import ReedSolomon, ReedSolomonError, encode_matrix
+
+
+def test_encode_matrix_systematic():
+    m = encode_matrix(4, 2)
+    assert np.array_equal(m[:4], np.eye(4, dtype=np.uint8))
+    assert m.shape == (6, 4)
+
+
+def test_every_k_subset_invertible():
+    m = encode_matrix(4, 3)
+    for rows in itertools.combinations(range(7), 4):
+        gf256.mat_inv(m[list(rows)])  # raises if singular
+
+
+def test_roundtrip_no_erasure():
+    rs = ReedSolomon(4, 2)
+    payload = bytes(range(100))
+    shards = rs.encode_bytes(payload)
+    assert len(shards) == 6
+    assert rs.reconstruct_data(shards) == payload
+
+
+@pytest.mark.parametrize("missing", [(0,), (5,), (0, 5), (1, 2), (4, 5), (0, 1)])
+def test_roundtrip_with_erasures(missing):
+    rs = ReedSolomon(4, 2)
+    payload = b"The quick brown fox jumps over the lazy dog" * 3
+    shards = rs.encode_bytes(payload)
+    holes = [s if i not in missing else None for i, s in enumerate(shards)]
+    assert rs.reconstruct_data(holes) == payload
+
+
+def test_reconstruct_restores_parity_too():
+    rs = ReedSolomon(3, 2)
+    data = np.arange(30, dtype=np.uint8).reshape(3, 10)
+    full = rs.encode(data)
+    holes = [full[i] if i not in (1, 4) else None for i in range(5)]
+    restored = rs.reconstruct(holes)
+    for i in range(5):
+        assert np.array_equal(restored[i], full[i])
+    assert rs.verify(restored)
+
+
+def test_too_few_shards_raises():
+    rs = ReedSolomon(4, 2)
+    shards = rs.encode_bytes(b"x" * 50)
+    holes = [s if i in (0, 1, 2) else None for i, s in enumerate(shards)]
+    with pytest.raises(ReedSolomonError):
+        rs.reconstruct_data(holes)
+
+
+def test_verify_detects_corruption():
+    rs = ReedSolomon(4, 2)
+    data = np.random.default_rng(0).integers(0, 256, (4, 16)).astype(np.uint8)
+    full = rs.encode(data)
+    assert rs.verify(list(full))
+    full[5, 0] ^= 1
+    assert not rs.verify(list(full))
+
+
+@pytest.mark.parametrize("k,p", [(1, 1), (2, 1), (16, 8), (42, 21), (170, 85)])
+def test_various_geometries(k, p):
+    rs = ReedSolomon(k, p)
+    payload = bytes(np.random.default_rng(k).integers(0, 256, 257).astype(np.uint8))
+    shards = rs.encode_bytes(payload)
+    # kill the last p shards
+    holes = [s if i < k else None for i, s in enumerate(shards)]
+    assert rs.reconstruct_data(holes) == payload
+
+
+def test_empty_payload():
+    rs = ReedSolomon(4, 2)
+    shards = rs.encode_bytes(b"")
+    assert rs.reconstruct_data(shards) == b""
+
+
+def test_total_shards_cap():
+    with pytest.raises(ReedSolomonError):
+        ReedSolomon(200, 100)

@@ -1,0 +1,71 @@
+// GF(2^8) Reed-Solomon matmul — native host hot path.
+//
+// Plays the role of the SIMD `reed-solomon-erasure` crate in the reference
+// (SURVEY.md §2.2): the CPU CryptoEngine's RS encode/reconstruct inner loop.
+// Exposed as a C ABI consumed via ctypes (hydrabadger_tpu/crypto/_native.py).
+//
+// Strategy: per output row, accumulate XOR of constant-multiplier table rows.
+// The 256x256 multiplication table lives in L1/L2; for each (row, k) matrix
+// entry we stream the k-th input shard once through its 256-byte lookup row.
+// Compilers auto-vectorise the inner XOR/gather loop; this is the classic
+// table-lookup formulation the SIMD crate uses (shuffle-based there).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+const uint16_t kPoly = 0x11d;
+
+struct Tables {
+  uint8_t mul[256][256];
+  Tables() {
+    uint8_t exp[512];
+    int log[256] = {0};
+    int x = 1;
+    for (int i = 0; i < 255; ++i) {
+      exp[i] = static_cast<uint8_t>(x);
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= kPoly;
+    }
+    for (int i = 255; i < 512; ++i) exp[i] = exp[i - 255];
+    for (int a = 0; a < 256; ++a)
+      for (int b = 0; b < 256; ++b)
+        mul[a][b] = (a && b) ? exp[log[a] + log[b]] : 0;
+  }
+};
+
+const Tables kTables;
+
+}  // namespace
+
+extern "C" {
+
+// out[m,n] = a[m,k] * b[k,n] over GF(2^8).
+void gf256_matmul(const uint8_t* a, const uint8_t* b, uint8_t* out,
+                  int64_t m, int64_t k, int64_t n) {
+  std::memset(out, 0, static_cast<size_t>(m) * n);
+  for (int64_t i = 0; i < m; ++i) {
+    uint8_t* dst = out + i * n;
+    for (int64_t kk = 0; kk < k; ++kk) {
+      const uint8_t coef = a[i * k + kk];
+      if (coef == 0) continue;
+      const uint8_t* row = kTables.mul[coef];
+      const uint8_t* src = b + kk * n;
+      if (coef == 1) {
+        for (int64_t j = 0; j < n; ++j) dst[j] ^= src[j];
+      } else {
+        for (int64_t j = 0; j < n; ++j) dst[j] ^= row[src[j]];
+      }
+    }
+  }
+}
+
+// Element-wise c = a * b over GF(2^8), length n.
+void gf256_mul_vec(const uint8_t* a, const uint8_t* b, uint8_t* out,
+                   int64_t n) {
+  for (int64_t j = 0; j < n; ++j) out[j] = kTables.mul[a[j]][b[j]];
+}
+
+}  // extern "C"

@@ -1,0 +1,46 @@
+"""C++ native GF(2^8) library parity with the numpy reference."""
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hydrabadger_tpu.crypto import _native, gf256
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _ensure_built():
+    if _native.native_available():
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", str(ROOT / "native")], check=True, capture_output=True
+        )
+    except (OSError, subprocess.CalledProcessError):
+        return False
+    _native._LIB = None  # force re-probe
+    return _native.native_available()
+
+
+pytestmark = pytest.mark.skipif(
+    not _ensure_built(), reason="native toolchain unavailable"
+)
+
+
+def test_native_matmul_matches_numpy():
+    rng = np.random.default_rng(7)
+    for m, k, n in [(1, 1, 1), (3, 5, 17), (32, 64, 1000), (255, 128, 64)]:
+        a = rng.integers(0, 256, (m, k)).astype(np.uint8)
+        b = rng.integers(0, 256, (k, n)).astype(np.uint8)
+        assert np.array_equal(_native.gf_matmul(a, b), gf256.matmul(a, b))
+
+
+def test_rs_uses_native_consistently():
+    from hydrabadger_tpu.crypto.rs import ReedSolomon
+
+    rs = ReedSolomon(8, 4)
+    payload = bytes(np.random.default_rng(8).integers(0, 256, 1000).astype(np.uint8))
+    shards = rs.encode_bytes(payload)
+    holes = [s if i % 3 != 0 else None for i, s in enumerate(shards)]
+    assert rs.reconstruct_data(holes) == payload

@@ -1,0 +1,656 @@
+"""BLS12-381 pairing-friendly curve — pure-Python CPU reference engine.
+
+TPU-native framework equivalent of the `threshold_crypto`/`pairing` Rust
+crates the reference leans on for node identity, per-message signatures and
+threshold encryption (use sites: /root/reference/src/hydrabadger/hydrabadger.rs:131,
+src/lib.rs:406-447; SURVEY.md §2.2).  This module is the bit-exact oracle
+the batched TPU limb kernels (ops/bls_jax.py) are tested against.
+
+Layout:
+  - FQ / FQ2 / FQ12: field elements.  FQ12 uses the polynomial basis
+    Fp[w]/(w^12 - 2 w^6 + 2); Fp2 embeds via u = w^6 - 1 (so u^2 = -1).
+  - Curve points: projective (X, Y, Z) tuples, Z == 0 at infinity.
+    G1 over FQ (y^2 = x^3 + 4), G2 over FQ2 (y^2 = x^3 + 4(u+1)).
+  - Optimal ate pairing: twist G2 into E(Fp12), projective Miller loop over
+    |x| = 0xd201000000010000, structured final exponentiation
+    (conjugation easy part + (p^4 - p^2 + 1)/r hard part).
+  - hash_to_g2: deterministic try-and-increment + cofactor clearing, with
+    both cofactors derived from the BLS parameter x at import time.
+
+All scalars/coefficients are plain Python ints (mod P) for speed.
+"""
+from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+X_PARAM = -0xD201000000010000  # the BLS parameter; negative for this curve
+
+assert (P**4 - P**2 + 1) % R == 0
+
+# Cofactors derived from x (standard BLS12 formulas).
+H1_COFACTOR = (X_PARAM - 1) ** 2 // 3
+_h2_num = (
+    X_PARAM**8
+    - 4 * X_PARAM**7
+    + 5 * X_PARAM**6
+    - 4 * X_PARAM**4
+    + 6 * X_PARAM**3
+    - 4 * X_PARAM**2
+    - 4 * X_PARAM
+    + 13
+)
+assert _h2_num % 9 == 0
+H2_COFACTOR = _h2_num // 9
+
+
+# ---------------------------------------------------------------------------
+# Field elements
+# ---------------------------------------------------------------------------
+
+
+class FQ:
+    """Element of the prime field Fp."""
+
+    __slots__ = ("n",)
+
+    def __init__(self, n: int):
+        self.n = n % P
+
+    def __add__(self, other):
+        return FQ(self.n + (other.n if isinstance(other, FQ) else other))
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return FQ(self.n - (other.n if isinstance(other, FQ) else other))
+
+    def __rsub__(self, other):
+        return FQ(other - self.n)
+
+    def __mul__(self, other):
+        return FQ(self.n * (other.n if isinstance(other, FQ) else other))
+
+    __rmul__ = __mul__
+
+    def __neg__(self):
+        return FQ(-self.n)
+
+    def __eq__(self, other):
+        if isinstance(other, FQ):
+            return self.n == other.n
+        return self.n == other % P
+
+    def __hash__(self):
+        return hash(("FQ", self.n))
+
+    def inv(self):
+        return FQ(pow(self.n, -1, P))
+
+    def __truediv__(self, other):
+        return self * other.inv()
+
+    def __pow__(self, e: int):
+        return FQ(pow(self.n, e, P))
+
+    def __repr__(self):
+        return f"FQ(0x{self.n:x})"
+
+    def sqrt(self):
+        """Square root (P ≡ 3 mod 4), or None if non-residue."""
+        c = pow(self.n, (P + 1) // 4, P)
+        return FQ(c) if c * c % P == self.n else None
+
+    @classmethod
+    def one(cls):
+        return cls(1)
+
+    @classmethod
+    def zero(cls):
+        return cls(0)
+
+
+class _FQP:
+    """Polynomial extension field over Fp; coeffs are plain ints mod P."""
+
+    __slots__ = ("coeffs",)
+    degree: int = 0
+    # sparse (index, coeff) pairs of the modulus polynomial (sans leading 1)
+    mc_tuples: tuple = ()
+
+    def __init__(self, coeffs):
+        self.coeffs = [c % P for c in coeffs]
+        assert len(self.coeffs) == self.degree
+
+    def __add__(self, other):
+        return type(self)([a + b for a, b in zip(self.coeffs, other.coeffs)])
+
+    def __sub__(self, other):
+        return type(self)([a - b for a, b in zip(self.coeffs, other.coeffs)])
+
+    def __neg__(self):
+        return type(self)([-a for a in self.coeffs])
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.coeffs == other.coeffs
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(self.coeffs)))
+
+    def __mul__(self, other):
+        if isinstance(other, int):
+            return type(self)([c * other for c in self.coeffs])
+        if isinstance(other, FQ):
+            return type(self)([c * other.n for c in self.coeffs])
+        d = self.degree
+        b = [0] * (d * 2 - 1)
+        sc, oc = self.coeffs, other.coeffs
+        for i in range(d):
+            ai = sc[i]
+            if ai:
+                for j in range(d):
+                    b[i + j] += ai * oc[j]
+        for exp in range(d * 2 - 2, d - 1, -1):
+            top = b[exp]
+            if top:
+                b[exp] = 0
+                for i, c in self.mc_tuples:
+                    b[exp - d + i] -= top * c
+        return type(self)([c % P for c in b[:d]])
+
+    __rmul__ = __mul__
+
+    def square(self):
+        return self * self
+
+    def __pow__(self, e: int):
+        result = type(self).one()
+        base = self
+        if e < 0:
+            base = base.inv()
+            e = -e
+        while e:
+            if e & 1:
+                result = result * base
+            base = base * base
+            e >>= 1
+        return result
+
+    def inv(self):
+        """Extended-Euclid inversion in the polynomial quotient ring."""
+        d = self.degree
+        lm, hm = [1] + [0] * d, [0] * (d + 1)
+        low = self.coeffs + [0]
+        high = [0] * (d + 1)
+        for i, c in self.mc_tuples:
+            high[i] = c % P
+        high[d] = 1
+        while _deg(low):
+            r = _poly_rounded_div(high, low)
+            r += [0] * (d + 1 - len(r))
+            nm, new = hm[:], high[:]
+            for i in range(d + 1):
+                for j in range(d + 1 - i):
+                    nm[i + j] -= lm[i] * r[j]
+                    new[i + j] -= low[i] * r[j]
+            nm = [x % P for x in nm]
+            new = [x % P for x in new]
+            lm, low, hm, high = nm, new, lm, low
+        inv_low0 = pow(low[0], -1, P)
+        return type(self)([c * inv_low0 % P for c in lm[:d]])
+
+    def __truediv__(self, other):
+        return self * other.inv()
+
+    def is_zero(self):
+        return all(c == 0 for c in self.coeffs)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.coeffs})"
+
+    @classmethod
+    def one(cls):
+        return cls([1] + [0] * (cls.degree - 1))
+
+    @classmethod
+    def zero(cls):
+        return cls([0] * cls.degree)
+
+
+def _deg(poly):
+    d = len(poly) - 1
+    while d and poly[d] == 0:
+        d -= 1
+    return d
+
+
+def _poly_rounded_div(a, b):
+    dega, degb = _deg(a), _deg(b)
+    temp = a[:]
+    out = [0] * len(a)
+    inv_b = pow(b[degb], -1, P)
+    for i in range(dega - degb, -1, -1):
+        out[i] = (out[i] + temp[degb + i] * inv_b) % P
+        for c in range(degb + 1):
+            temp[c + i] = (temp[c + i] - out[i] * b[c]) % P
+    return [x % P for x in out[: _deg(out) + 1]]
+
+
+class FQ2(_FQP):
+    """Fp2 = Fp[u]/(u^2 + 1)."""
+
+    degree = 2
+    mc_tuples = ((0, 1),)
+
+    def conjugate(self):
+        return FQ2([self.coeffs[0], -self.coeffs[1]])
+
+    def sqrt(self):
+        """Square root via the norm method, or None if non-residue."""
+        a0, a1 = self.coeffs
+        if a1 == 0:
+            r = FQ(a0).sqrt()
+            if r is not None:
+                return FQ2([r.n, 0])
+            # a0 is a non-residue in Fp: sqrt is purely imaginary
+            r = (FQ(a0) * FQ(-1).inv()).sqrt()  # sqrt(-a0)
+            return FQ2([0, r.n]) if r is not None else None
+        norm = FQ(a0 * a0 + a1 * a1)
+        alpha = norm.sqrt()
+        if alpha is None:
+            return None
+        inv2 = pow(2, -1, P)
+        delta = (a0 + alpha.n) * inv2 % P
+        x0 = FQ(delta).sqrt()
+        if x0 is None:
+            delta = (a0 - alpha.n) * inv2 % P
+            x0 = FQ(delta).sqrt()
+            if x0 is None:
+                return None
+        x1 = a1 * pow(2 * x0.n, -1, P) % P
+        cand = FQ2([x0.n, x1])
+        return cand if cand * cand == self else None
+
+
+class FQ12(_FQP):
+    """Fp12 = Fp[w]/(w^12 - 2 w^6 + 2); Fp2 embeds via u = w^6 - 1."""
+
+    degree = 12
+    mc_tuples = ((0, 2), (6, -2))
+
+    def conjugate(self):
+        """f^(p^6): w -> -w, i.e. negate odd coefficients."""
+        return FQ12([c if i % 2 == 0 else -c for i, c in enumerate(self.coeffs)])
+
+
+def fq2_to_fq12(el: FQ2) -> FQ12:
+    """Embed a0 + a1*u  ->  (a0 - a1) + a1*w^6."""
+    a0, a1 = el.coeffs
+    co = [0] * 12
+    co[0] = a0 - a1
+    co[6] = a1
+    return FQ12(co)
+
+
+# ---------------------------------------------------------------------------
+# Curve ops (projective: (X, Y, Z), point = (X/Z, Y/Z), infinity when Z == 0)
+# ---------------------------------------------------------------------------
+
+B1 = FQ(4)
+B2 = FQ2([4, 4])
+B12 = FQ12([4] + [0] * 11)
+
+G1 = (
+    FQ(0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB),
+    FQ(0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1),
+    FQ(1),
+)
+G2 = (
+    FQ2([
+        0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+    ]),
+    FQ2([
+        0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+    ]),
+    FQ2([1, 0]),
+)
+
+
+def is_inf(pt) -> bool:
+    z = pt[2]
+    return z == 0 if isinstance(z, FQ) else z.is_zero()
+
+
+def infinity(field):
+    return (field.one(), field.one(), field.zero())
+
+
+def double(pt):
+    x, y, z = pt
+    W = 3 * x * x
+    S = y * z
+    B = x * y * S
+    H = W * W - 8 * B
+    S_sq = S * S
+    return (
+        2 * H * S,
+        W * (4 * B - H) - 8 * y * y * S_sq,
+        8 * S * S_sq,
+    )
+
+
+def add(p1, p2):
+    if is_inf(p1):
+        return p2
+    if is_inf(p2):
+        return p1
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    U1 = y2 * z1
+    U2 = y1 * z2
+    V1 = x2 * z1
+    V2 = x1 * z2
+    if V1 == V2:
+        if U1 == U2:
+            return double(p1)
+        return infinity(type(x1) if not isinstance(x1, FQ) else FQ)
+    U = U1 - U2
+    V = V1 - V2
+    V_sq = V * V
+    V_sq_V2 = V_sq * V2
+    V_cu = V * V_sq
+    W = z1 * z2
+    A = U * U * W - V_cu - 2 * V_sq_V2
+    return (V * A, U * (V_sq_V2 - A) - V_cu * U2, V_cu * W)
+
+
+def neg(pt):
+    x, y, z = pt
+    return (x, -y, z)
+
+
+def multiply(pt, n: int):
+    """Scalar multiplication (double-and-add, MSB first)."""
+    if n < 0:
+        return multiply(neg(pt), -n)
+    if n == 0 or is_inf(pt):
+        return infinity(type(pt[0]) if not isinstance(pt[0], FQ) else FQ)
+    result = None
+    for bit in bin(n)[2:]:
+        if result is not None:
+            result = double(result)
+        if bit == "1":
+            result = pt if result is None else add(result, pt)
+    return result
+
+
+def normalize(pt):
+    """Projective -> affine (x, y); None at infinity."""
+    if is_inf(pt):
+        return None
+    x, y, z = pt
+    zinv = z.inv()
+    return (x * zinv, y * zinv)
+
+
+def eq(p1, p2) -> bool:
+    if is_inf(p1) or is_inf(p2):
+        return is_inf(p1) and is_inf(p2)
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    return x1 * z2 == x2 * z1 and y1 * z2 == y2 * z1
+
+
+def is_on_curve(pt, b) -> bool:
+    if is_inf(pt):
+        return True
+    x, y, z = pt
+    # y^2 z = x^3 + b z^3
+    return y * y * z == x * x * x + b * z * z * z
+
+
+# ---------------------------------------------------------------------------
+# Pairing
+# ---------------------------------------------------------------------------
+
+_W = FQ12([0, 1] + [0] * 10)
+_W2_INV = (_W * _W).inv()
+_W3_INV = (_W * _W * _W).inv()
+
+
+def twist(pt):
+    """Map a G2 point (over Fp2, curve b=4(u+1)) into E(Fp12) (b=4)."""
+    x, y, z = pt
+    nx = fq2_to_fq12(x) * _W2_INV
+    ny = fq2_to_fq12(y) * _W3_INV
+    nz = fq2_to_fq12(z)
+    return (nx, ny, nz)
+
+
+def cast_g1_to_fq12(pt):
+    x, y, z = pt
+    return (
+        FQ12([x.n] + [0] * 11),
+        FQ12([y.n] + [0] * 11),
+        FQ12([z.n] + [0] * 11),
+    )
+
+
+def _linefunc(p1, p2, t):
+    """Line through p1, p2 evaluated at t; returns (numerator, denominator)."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    xt, yt, zt = t
+    m_num = y2 * z1 - y1 * z2
+    m_den = x2 * z1 - x1 * z2
+    if not m_den.is_zero():
+        return (
+            m_num * (xt * z1 - x1 * zt) - m_den * (yt * z1 - y1 * zt),
+            m_den * zt * z1,
+        )
+    if m_num.is_zero():
+        m_num = 3 * x1 * x1
+        m_den = 2 * y1 * z1
+        return (
+            m_num * (xt * z1 - x1 * zt) - m_den * (yt * z1 - y1 * zt),
+            m_den * zt * z1,
+        )
+    return (xt * z1 - x1 * zt, z1 * zt)
+
+
+ATE_LOOP_COUNT = -X_PARAM  # 0xd201000000010000
+_HARD_EXP = (P**4 - P**2 + 1) // R
+
+
+@lru_cache(maxsize=1)
+def _frob2_basis():
+    """w^(i*p^2) for i in 0..11 — basis images under the p^2 Frobenius."""
+    wp2 = _W ** (P * P)
+    basis = [FQ12.one()]
+    for _ in range(11):
+        basis.append(basis[-1] * wp2)
+    return basis
+
+
+def _frobenius_p2(f: FQ12) -> FQ12:
+    """f^(p^2): coefficients are Fp (fixed); map w^i -> w^(i p^2)."""
+    basis = _frob2_basis()
+    acc = FQ12.zero()
+    for i, c in enumerate(f.coeffs):
+        if c:
+            acc = acc + basis[i] * c
+    return acc
+
+
+def final_exponentiation(f: FQ12) -> FQ12:
+    f1 = f.conjugate()  # f^(p^6)
+    f2 = f1 * f.inv()  # f^(p^6 - 1)
+    f3 = _frobenius_p2(f2) * f2  # f^((p^6-1)(p^2+1))
+    return f3**_HARD_EXP
+
+
+def miller_loop(q_twisted, p_casted) -> FQ12:
+    """Ate Miller loop; inputs are E(Fp12) projective points."""
+    if is_inf(q_twisted) or is_inf(p_casted):
+        return FQ12.one()
+    r_pt = q_twisted
+    f_num, f_den = FQ12.one(), FQ12.one()
+    for b in bin(ATE_LOOP_COUNT)[3:]:  # skip MSB
+        n_, d_ = _linefunc(r_pt, r_pt, p_casted)
+        f_num = f_num * f_num * n_
+        f_den = f_den * f_den * d_
+        r_pt = double(r_pt)
+        if b == "1":
+            n_, d_ = _linefunc(r_pt, q_twisted, p_casted)
+            f_num = f_num * n_
+            f_den = f_den * d_
+            r_pt = add(r_pt, q_twisted)
+    return f_num / f_den
+
+
+def pairing(q, p, final: bool = True) -> FQ12:
+    """e(p ∈ G1, q ∈ G2) — note hbbft-style argument order (G2 first)."""
+    f = miller_loop(twist(q), cast_g1_to_fq12(p))
+    return final_exponentiation(f) if final else f
+
+
+def pairing_check_eq(p1, q1, p2, q2) -> bool:
+    """e(p1, q1) == e(p2, q2) with a single final exponentiation.
+
+    Uses e(p1,q1) * e(-p2,q2) == 1.
+    """
+    f = miller_loop(twist(q1), cast_g1_to_fq12(p1)) * miller_loop(
+        twist(q2), cast_g1_to_fq12(neg(p2))
+    )
+    return final_exponentiation(f) == FQ12.one()
+
+
+# ---------------------------------------------------------------------------
+# Hashing / serialization
+# ---------------------------------------------------------------------------
+
+
+def _expand_message(msg: bytes, domain: bytes, n_bytes: int) -> bytes:
+    out = b""
+    counter = 0
+    while len(out) < n_bytes:
+        out += hashlib.sha256(
+            domain + counter.to_bytes(4, "big") + msg
+        ).digest()
+        counter += 1
+    return out[:n_bytes]
+
+
+def hash_to_fr(msg: bytes, domain: bytes = b"HBTPU-FR") -> int:
+    return int.from_bytes(_expand_message(msg, domain, 40), "big") % R
+
+
+def hash_to_g2(msg: bytes, domain: bytes = b"HBTPU-G2") -> tuple:
+    """Deterministic hash onto the r-torsion of E'(Fp2).
+
+    Try-and-increment on x, then cofactor clearing by H2.  Not the IETF
+    hash-to-curve suite (the reference's threshold_crypto predates it too);
+    internally consistent and constant across engines, which is what the
+    protocol requires.
+    """
+    ctr = 0
+    while True:
+        raw = _expand_message(msg, domain + ctr.to_bytes(4, "big"), 97)
+        x = FQ2([
+            int.from_bytes(raw[0:48], "big"),
+            int.from_bytes(raw[48:96], "big"),
+        ])
+        rhs = x * x * x + B2
+        y = rhs.sqrt()
+        if y is not None:
+            if raw[96] & 1:
+                y = -y
+            pt = multiply((x, y, FQ2.one()), H2_COFACTOR)
+            if not is_inf(pt):
+                return pt
+        ctr += 1
+
+
+def _fq_sign(n: int) -> int:
+    return 1 if n > (P - 1) // 2 else 0
+
+
+def g1_to_bytes(pt) -> bytes:
+    """48-byte compressed encoding (zcash-style flag bits)."""
+    aff = normalize(pt)
+    if aff is None:
+        return bytes([0xC0] + [0] * 47)
+    x, y = aff
+    out = bytearray(x.n.to_bytes(48, "big"))
+    out[0] |= 0x80  # compressed
+    if _fq_sign(y.n):
+        out[0] |= 0x20
+    return bytes(out)
+
+
+def g1_from_bytes(raw: bytes):
+    if len(raw) != 48:
+        raise ValueError("G1 encoding must be 48 bytes")
+    if raw[0] & 0x40:
+        return infinity(FQ)
+    sign = (raw[0] >> 5) & 1
+    xn = int.from_bytes(bytes([raw[0] & 0x1F]) + raw[1:], "big")
+    x = FQ(xn)
+    y = (x * x * x + B1).sqrt()
+    if y is None:
+        raise ValueError("invalid G1 x coordinate")
+    if _fq_sign(y.n) != sign:
+        y = -y
+    pt = (x, y, FQ(1))
+    if not is_on_curve(pt, B1):
+        raise ValueError("point not on curve")
+    return pt
+
+
+def g2_to_bytes(pt) -> bytes:
+    """96-byte compressed encoding (c1 || c0, flags in first byte)."""
+    aff = normalize(pt)
+    if aff is None:
+        return bytes([0xC0] + [0] * 95)
+    x, y = aff
+    out = bytearray(
+        x.coeffs[1].to_bytes(48, "big") + x.coeffs[0].to_bytes(48, "big")
+    )
+    out[0] |= 0x80
+    sign = (
+        _fq_sign(y.coeffs[1])
+        if y.coeffs[1] != 0
+        else _fq_sign(y.coeffs[0])
+    )
+    if sign:
+        out[0] |= 0x20
+    return bytes(out)
+
+
+def g2_from_bytes(raw: bytes):
+    if len(raw) != 96:
+        raise ValueError("G2 encoding must be 96 bytes")
+    if raw[0] & 0x40:
+        return infinity(FQ2)
+    sign = (raw[0] >> 5) & 1
+    c1 = int.from_bytes(bytes([raw[0] & 0x1F]) + raw[1:48], "big")
+    c0 = int.from_bytes(raw[48:96], "big")
+    x = FQ2([c0, c1])
+    y = (x * x * x + B2).sqrt()
+    if y is None:
+        raise ValueError("invalid G2 x coordinate")
+    ysign = _fq_sign(y.coeffs[1]) if y.coeffs[1] != 0 else _fq_sign(y.coeffs[0])
+    if ysign != sign:
+        y = -y
+    pt = (x, y, FQ2.one())
+    if not is_on_curve(pt, B2):
+        raise ValueError("point not on curve")
+    return pt

@@ -1,0 +1,321 @@
+"""Synchronous distributed key generation (Pedersen-style, trustless).
+
+Re-creates hbbft's `sync_key_gen` surface as used by the reference's keygen
+orchestration (/root/reference/src/hydrabadger/key_gen.rs:9-12,207,305;
+state.rs:276-278): `SyncKeyGen` with `Part` / `Ack` messages and
+`PartOutcome` / `AckOutcome` results, culminating in
+`generate() -> (PublicKeySet, SecretKeyShare)`.
+
+Protocol (symmetric bivariate polynomial secret sharing):
+  - Every proposer s samples a random *symmetric* bivariate polynomial
+    f_s(x, y) of degree t in each variable and publishes a commitment
+    matrix C_s[j][k] = g1 * c_jk, plus, for each node m, the row
+    f_s(m+1, y) encrypted to m's public key.
+  - Node m verifies its row against C_s and replies with an Ack carrying
+    f_s(m+1, j+1) encrypted to each node j.
+  - Node i verifies each acked value against C_s, and once t+1 values for
+    proposal s arrive, can interpolate the column poly f_s(·, i+1) at 0.
+  - generate(): over all complete proposals,
+        sk_share_i = Σ_s f_s(0, i+1),
+        pk_set commitment = Σ_s C_s row at x=0.
+    The master secret Σ_s f_s(0, 0) is never materialised anywhere.
+
+Node indices are dense 0..n-1 over the sorted node-id list; polynomial
+evaluation points are index+1 (0 is the master).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Generic, Hashable, List, Mapping, Optional, Tuple, TypeVar
+
+from ..utils import codec
+from . import bls12_381 as bls
+from .bls12_381 import FQ, G1, R, add, eq, g1_from_bytes, g1_to_bytes, infinity, multiply
+from .threshold import (
+    Ciphertext,
+    PublicKey,
+    PublicKeySet,
+    SecretKey,
+    SecretKeyShare,
+    fr_random,
+    poly_eval,
+    poly_interpolate_at_zero,
+)
+
+N = TypeVar("N", bound=Hashable)
+
+
+# ---------------------------------------------------------------------------
+# Bivariate polynomials and commitments
+# ---------------------------------------------------------------------------
+
+
+class BivarPoly:
+    """Symmetric bivariate polynomial over Fr, degree t in each variable."""
+
+    def __init__(self, coeffs: List[List[int]]):
+        self.t = len(coeffs) - 1
+        self.coeffs = coeffs  # coeffs[j][k], symmetric
+
+    @classmethod
+    def random(cls, t: int, rng) -> "BivarPoly":
+        coeffs = [[0] * (t + 1) for _ in range(t + 1)]
+        for j in range(t + 1):
+            for k in range(j, t + 1):
+                v = fr_random(rng)
+                coeffs[j][k] = v
+                coeffs[k][j] = v
+        return cls(coeffs)
+
+    def evaluate(self, x: int, y: int) -> int:
+        acc = 0
+        xj = 1
+        for j in range(self.t + 1):
+            acc = (acc + xj * poly_eval(self.coeffs[j], y)) % R
+            xj = xj * x % R
+        return acc
+
+    def row(self, x: int) -> List[int]:
+        """Univariate poly in y: coefficients of f(x, ·)."""
+        xs = [pow(x, j, R) for j in range(self.t + 1)]
+        return [
+            sum(xs[j] * self.coeffs[j][k] for j in range(self.t + 1)) % R
+            for k in range(self.t + 1)
+        ]
+
+    def commitment(self) -> "BivarCommitment":
+        return BivarCommitment(
+            [[multiply(G1, c) for c in row] for row in self.coeffs]
+        )
+
+
+class BivarCommitment:
+    """g1-commitment matrix to a bivariate polynomial."""
+
+    def __init__(self, points: List[List[tuple]]):
+        self.t = len(points) - 1
+        self.points = points
+
+    def evaluate(self, x: int, y: int) -> tuple:
+        acc = infinity(FQ)
+        xj = 1
+        for j in range(self.t + 1):
+            yk = 1
+            for k in range(self.t + 1):
+                acc = add(acc, multiply(self.points[j][k], xj * yk % R))
+                yk = yk * y % R
+            xj = xj * x % R
+        return acc
+
+    def row_commitment(self, x: int) -> List[tuple]:
+        """Commitment to the univariate row poly f(x, ·)."""
+        xs = [pow(x, j, R) for j in range(self.t + 1)]
+        out = []
+        for k in range(self.t + 1):
+            acc = infinity(FQ)
+            for j in range(self.t + 1):
+                acc = add(acc, multiply(self.points[j][k], xs[j]))
+            out.append(acc)
+        return out
+
+    def to_bytes(self) -> bytes:
+        return codec.encode(
+            [[g1_to_bytes(p) for p in row] for row in self.points]
+        )
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "BivarCommitment":
+        rows = codec.decode(raw)
+        return cls([[g1_from_bytes(p) for p in row] for row in rows])
+
+
+# ---------------------------------------------------------------------------
+# Messages
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Part:
+    """Proposal: commitment + per-node encrypted rows (index-ordered)."""
+
+    commit_bytes: bytes
+    enc_rows: Tuple[bytes, ...]
+
+    def commitment(self) -> BivarCommitment:
+        return BivarCommitment.from_bytes(self.commit_bytes)
+
+
+@dataclass(frozen=True)
+class Ack:
+    """Acknowledgement of proposer's part: per-node encrypted values."""
+
+    proposer_idx: int
+    enc_values: Tuple[bytes, ...]
+
+
+@dataclass
+class PartOutcome:
+    valid: bool
+    ack: Optional[Ack] = None
+    fault: Optional[str] = None
+
+
+@dataclass
+class AckOutcome:
+    valid: bool
+    fault: Optional[str] = None
+
+
+@dataclass
+class _ProposalState:
+    commitment: BivarCommitment
+    row: Optional[List[int]] = None  # our decrypted row f_s(i+1, y)
+    values: Dict[int, int] = field(default_factory=dict)  # acker idx+1 -> val
+    acks: set = field(default_factory=set)
+
+    def is_complete(self, threshold: int) -> bool:
+        return len(self.values) > threshold
+
+
+# ---------------------------------------------------------------------------
+# SyncKeyGen
+# ---------------------------------------------------------------------------
+
+
+class SyncKeyGen(Generic[N]):
+    """One node's view of a synchronous DKG session.
+
+    `pub_keys` maps node id -> BLS PublicKey for row/value transport
+    encryption; indices are positions in the sorted id list.
+    """
+
+    def __init__(
+        self,
+        our_id: N,
+        our_sk: SecretKey,
+        pub_keys: Mapping[N, PublicKey],
+        threshold: int,
+        rng,
+    ):
+        self.our_id = our_id
+        self.our_sk = our_sk
+        self.node_ids = sorted(pub_keys.keys())
+        self.pub_keys = dict(pub_keys)
+        self.threshold = threshold
+        self.rng = rng
+        if our_id not in self.pub_keys:
+            raise ValueError("our_id must be among pub_keys")
+        if len(self.node_ids) <= threshold:
+            raise ValueError("need more than `threshold` nodes")
+        self.our_idx = self.node_ids.index(our_id)
+        self.parts: Dict[int, _ProposalState] = {}
+
+    # -- proposing ----------------------------------------------------------
+
+    def propose(self) -> Part:
+        poly = BivarPoly.random(self.threshold, self.rng)
+        commit = poly.commitment()
+        enc_rows = []
+        for m, nid in enumerate(self.node_ids):
+            row = poly.row(m + 1)
+            enc_rows.append(
+                self.pub_keys[nid].encrypt(codec.encode(row), self.rng).to_bytes()
+            )
+        return Part(commit.to_bytes(), tuple(enc_rows))
+
+    # -- handling -----------------------------------------------------------
+
+    def node_index(self, node_id: N) -> int:
+        return self.node_ids.index(node_id)
+
+    def handle_part(self, sender_id: N, part: Part) -> PartOutcome:
+        s = self.node_index(sender_id)
+        if s in self.parts:
+            existing = self.parts[s]
+            if existing.commitment.to_bytes() != part.commit_bytes:
+                return PartOutcome(False, fault="conflicting part")
+            return PartOutcome(True)  # duplicate; ack already sent
+        try:
+            commit = part.commitment()
+        except (ValueError, TypeError):
+            return PartOutcome(False, fault="undecodable commitment")
+        if commit.t != self.threshold:
+            return PartOutcome(False, fault="wrong degree")
+        if len(part.enc_rows) != len(self.node_ids):
+            return PartOutcome(False, fault="wrong row count")
+        try:
+            ct = Ciphertext.from_bytes(part.enc_rows[self.our_idx])
+            raw = self.our_sk.decrypt(ct, verify=False)
+            row = [int(c) % R for c in codec.decode(raw)]
+        except (ValueError, TypeError):
+            return PartOutcome(False, fault="undecryptable row")
+        if len(row) != self.threshold + 1:
+            return PartOutcome(False, fault="wrong row degree")
+        # verify our row against the commitment
+        expected = commit.row_commitment(self.our_idx + 1)
+        for k, coeff in enumerate(row):
+            if not eq(multiply(G1, coeff), expected[k]):
+                return PartOutcome(False, fault="row/commitment mismatch")
+        state = _ProposalState(commit, row=row)
+        self.parts[s] = state
+        # our own consistent value: f_s(our_idx+1, our_idx+1)
+        enc_values = []
+        for m, nid in enumerate(self.node_ids):
+            val = poly_eval(row, m + 1)
+            enc_values.append(
+                self.pub_keys[nid]
+                .encrypt(val.to_bytes(32, "big"), self.rng)
+                .to_bytes()
+            )
+        return PartOutcome(True, ack=Ack(s, tuple(enc_values)))
+
+    def handle_ack(self, sender_id: N, ack: Ack) -> AckOutcome:
+        m = self.node_index(sender_id)
+        if ack.proposer_idx not in self.parts:
+            return AckOutcome(False, fault="ack for unknown part")
+        state = self.parts[ack.proposer_idx]
+        if m in state.acks:
+            return AckOutcome(True)  # duplicate
+        if len(ack.enc_values) != len(self.node_ids):
+            return AckOutcome(False, fault="wrong value count")
+        try:
+            ct = Ciphertext.from_bytes(ack.enc_values[self.our_idx])
+            raw = self.our_sk.decrypt(ct, verify=False)
+            val = int.from_bytes(raw, "big") % R
+        except (ValueError, TypeError):
+            return AckOutcome(False, fault="undecryptable value")
+        # verify val == f_s(m+1, our_idx+1) against commitment
+        expected = state.commitment.evaluate(m + 1, self.our_idx + 1)
+        if not eq(multiply(G1, val), expected):
+            return AckOutcome(False, fault="value/commitment mismatch")
+        state.acks.add(m)
+        state.values[m + 1] = val
+        return AckOutcome(True)
+
+    # -- completion ---------------------------------------------------------
+
+    def count_complete(self) -> int:
+        return sum(
+            1 for s in self.parts.values() if s.is_complete(self.threshold)
+        )
+
+    def is_ready(self) -> bool:
+        """Every node's proposal is complete (the reference's strict gate,
+        key_gen.rs:373-386 waits for n parts and n acks each)."""
+        return self.count_complete() == len(self.node_ids)
+
+    def generate(self) -> Tuple[PublicKeySet, SecretKeyShare]:
+        """Combine all complete proposals into (pk_set, our sk share)."""
+        if self.count_complete() == 0:
+            raise ValueError("no complete proposals")
+        t = self.threshold
+        commit_acc = [infinity(FQ) for _ in range(t + 1)]
+        sk_val = 0
+        for s, state in sorted(self.parts.items()):
+            if not state.is_complete(t):
+                continue
+            row0 = state.commitment.row_commitment(0)
+            commit_acc = [add(a, b) for a, b in zip(commit_acc, row0)]
+            pts = dict(list(state.values.items())[: t + 1])
+            sk_val = (sk_val + poly_interpolate_at_zero(pts)) % R
+        return PublicKeySet(commit_acc), SecretKeyShare(sk_val)

@@ -1,0 +1,388 @@
+"""Threshold BLS signatures + threshold encryption over BLS12-381.
+
+Re-creates the `threshold_crypto` crate surface the reference uses
+(SecretKey/PublicKey node identity at hydrabadger.rs:131, per-frame
+sign/verify at lib.rs:411,434, PublicKeySet/SecretKeyShare from DKG at
+state.rs:276-299; SURVEY.md §2.2):
+
+  - plain BLS signatures:  pk ∈ G1,  sig = H_G2(msg) * sk ∈ G2
+  - Shamir secret sharing of sk over Fr (shares evaluated at i+1)
+  - signature shares + Lagrange combination at 0 (the common coin)
+  - label-free hybrid threshold encryption (U, V, W):
+        U = g1*r,  V = m ⊕ KDF(pk*r),  W = H_G2(U, V)*r
+    decryption share = U*sk_i ∈ G1, share-verified by pairing, combined by
+    Lagrange interpolation in the exponent.
+
+Everything takes explicit rng / deterministic inputs — the framework
+threads randomness, never pulls ambient entropy inside protocol code
+(SURVEY.md §7 hard part 4).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Optional, Sequence
+
+from . import bls12_381 as bls
+from .bls12_381 import (
+    FQ,
+    FQ2,
+    G1,
+    G2,
+    R,
+    add,
+    eq,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_to_bytes,
+    hash_to_g2,
+    infinity,
+    is_inf,
+    multiply,
+    neg,
+    normalize,
+    pairing_check_eq,
+)
+
+# ---------------------------------------------------------------------------
+# Fr helpers
+# ---------------------------------------------------------------------------
+
+
+def fr_random(rng) -> int:
+    """Random nonzero Fr scalar from a `random.Random`-like rng."""
+    while True:
+        v = rng.getrandbits(256) % R
+        if v:
+            return v
+
+
+def poly_random(degree: int, rng) -> list[int]:
+    """Random polynomial over Fr: coeffs[k] is the x^k coefficient."""
+    return [fr_random(rng) for _ in range(degree + 1)]
+
+
+def poly_eval(coeffs: Sequence[int], x: int) -> int:
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % R
+    return acc
+
+
+def poly_interpolate_at_zero(points: Mapping[int, int]) -> int:
+    """Interpolate poly through {x: y} (x ∈ Fr, distinct) and evaluate at 0."""
+    acc = 0
+    xs = list(points.keys())
+    for xi in xs:
+        num, den = 1, 1
+        for xj in xs:
+            if xj == xi:
+                continue
+            num = num * xj % R
+            den = den * (xj - xi) % R
+        acc = (acc + points[xi] * num * pow(den, -1, R)) % R
+    return acc
+
+
+def lagrange_coeffs_at_zero(xs: Sequence[int]) -> list[int]:
+    out = []
+    for xi in xs:
+        num, den = 1, 1
+        for xj in xs:
+            if xj == xi:
+                continue
+            num = num * xj % R
+            den = den * (xj - xi) % R
+        out.append(num * pow(den, -1, R) % R)
+    return out
+
+
+def interpolate_g_at_zero(points: Mapping[int, tuple]) -> tuple:
+    """Lagrange interpolation *in the exponent*: Σ λ_i · P_i, at x=0."""
+    xs = list(points.keys())
+    lam = lagrange_coeffs_at_zero(xs)
+    first = points[xs[0]]
+    field = FQ if isinstance(first[0], FQ) else type(first[0])
+    acc = infinity(field)
+    for xi, li in zip(xs, lam):
+        acc = add(acc, multiply(points[xi], li))
+    return acc
+
+
+def _kdf(point, n_bytes: int, domain: bytes = b"HBTPU-KDF") -> bytes:
+    return bls._expand_message(g1_to_bytes(point), domain, n_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Keys and signatures
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Signature:
+    """BLS signature: a G2 point."""
+
+    point: tuple
+
+    def to_bytes(self) -> bytes:
+        return g2_to_bytes(self.point)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Signature":
+        return cls(g2_from_bytes(raw))
+
+    def parity(self) -> bool:
+        """Deterministic bit of the signature — the common-coin value."""
+        return bool(hashlib.sha256(self.to_bytes()).digest()[0] & 1)
+
+    def __eq__(self, other):
+        return isinstance(other, Signature) and eq(self.point, other.point)
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+
+class SignatureShare(Signature):
+    pass
+
+
+@dataclass(frozen=True)
+class PublicKey:
+    """G1 public key."""
+
+    point: tuple
+
+    def to_bytes(self) -> bytes:
+        return g1_to_bytes(self.point)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PublicKey":
+        return cls(g1_from_bytes(raw))
+
+    def verify(self, sig: Signature, msg: bytes) -> bool:
+        # e(g1, sig) == e(pk, H(msg))
+        return pairing_check_eq(G1, sig.point, self.point, hash_to_g2(msg))
+
+    def encrypt(self, msg: bytes, rng) -> "Ciphertext":
+        r = fr_random(rng)
+        u = multiply(G1, r)
+        v = bytes(
+            a ^ b for a, b in zip(msg, _kdf(multiply(self.point, r), len(msg)))
+        )
+        w = multiply(hash_to_g2(g1_to_bytes(u) + v, b"HBTPU-TE"), r)
+        return Ciphertext(u, v, w)
+
+    def __eq__(self, other):
+        return isinstance(other, PublicKey) and eq(self.point, other.point)
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+
+class PublicKeyShare(PublicKey):
+    def verify_decryption_share(
+        self, share: "DecryptionShare", ct: "Ciphertext"
+    ) -> bool:
+        # e(share, H(U,V)) == e(pk_i, W)
+        h = hash_to_g2(g1_to_bytes(ct.u) + ct.v, b"HBTPU-TE")
+        return pairing_check_eq(share.point, h, self.point, ct.w)
+
+
+@dataclass(frozen=True)
+class SecretKey:
+    """Fr scalar secret key."""
+
+    scalar: int
+
+    @classmethod
+    def random(cls, rng) -> "SecretKey":
+        return cls(fr_random(rng))
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "SecretKey":
+        return cls(int.from_bytes(raw, "big") % R)
+
+    def to_bytes(self) -> bytes:
+        return self.scalar.to_bytes(32, "big")
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(multiply(G1, self.scalar))
+
+    def sign(self, msg: bytes) -> Signature:
+        return Signature(multiply(hash_to_g2(msg), self.scalar))
+
+    def decrypt(self, ct: "Ciphertext", verify: bool = True) -> Optional[bytes]:
+        """Non-threshold decryption by the full key owner.
+
+        `verify=False` skips the pairing-based CCA check — used for DKG
+        transport where integrity is enforced by polynomial commitments.
+        """
+        if verify and not ct.verify():
+            return None
+        return bytes(
+            a ^ b
+            for a, b in zip(ct.v, _kdf(multiply(ct.u, self.scalar), len(ct.v)))
+        )
+
+
+class SecretKeyShare(SecretKey):
+    def sign_share(self, msg: bytes) -> SignatureShare:
+        return SignatureShare(multiply(hash_to_g2(msg), self.scalar))
+
+    def decrypt_share(self, ct: "Ciphertext") -> "DecryptionShare":
+        return DecryptionShare(multiply(ct.u, self.scalar))
+
+    def public_key_share(self) -> PublicKeyShare:
+        return PublicKeyShare(multiply(G1, self.scalar))
+
+
+# ---------------------------------------------------------------------------
+# Threshold encryption
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Ciphertext:
+    u: tuple  # G1
+    v: bytes
+    w: tuple  # G2
+
+    def verify(self) -> bool:
+        """CCA check: e(g1, W) == e(U, H(U, V))."""
+        h = hash_to_g2(g1_to_bytes(self.u) + self.v, b"HBTPU-TE")
+        return pairing_check_eq(G1, self.w, self.u, h)
+
+    def to_bytes(self) -> bytes:
+        return g1_to_bytes(self.u) + g2_to_bytes(self.w) + self.v
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Ciphertext":
+        return cls(g1_from_bytes(raw[:48]), raw[144:], g2_from_bytes(raw[48:144]))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Ciphertext)
+            and eq(self.u, other.u)
+            and self.v == other.v
+            and eq(self.w, other.w)
+        )
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+
+@dataclass(frozen=True)
+class DecryptionShare:
+    point: tuple  # G1
+
+    def to_bytes(self) -> bytes:
+        return g1_to_bytes(self.point)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "DecryptionShare":
+        return cls(g1_from_bytes(raw))
+
+
+# ---------------------------------------------------------------------------
+# Key sets (Shamir)
+# ---------------------------------------------------------------------------
+
+
+class SecretKeySet:
+    """Dealer-side master polynomial; degree == threshold t.
+
+    Any t+1 of the derived shares reconstruct; share i is poly(i+1),
+    matching the reference's threshold_crypto convention.
+    """
+
+    def __init__(self, coeffs: Sequence[int]):
+        self.coeffs = [c % R for c in coeffs]
+
+    @classmethod
+    def random(cls, threshold: int, rng) -> "SecretKeySet":
+        return cls(poly_random(threshold, rng))
+
+    @property
+    def threshold(self) -> int:
+        return len(self.coeffs) - 1
+
+    def secret_key(self) -> SecretKey:
+        return SecretKey(self.coeffs[0])
+
+    def secret_key_share(self, i: int) -> SecretKeyShare:
+        return SecretKeyShare(poly_eval(self.coeffs, i + 1))
+
+    def public_keys(self) -> "PublicKeySet":
+        return PublicKeySet([multiply(G1, c) for c in self.coeffs])
+
+
+class PublicKeySet:
+    """Commitment to the master polynomial: G1 point per coefficient."""
+
+    def __init__(self, commitment: Sequence[tuple]):
+        self.commitment = list(commitment)
+
+    @property
+    def threshold(self) -> int:
+        return len(self.commitment) - 1
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(self.commitment[0])
+
+    def public_key_share(self, i: int) -> PublicKeyShare:
+        x = i + 1
+        acc = infinity(FQ)
+        xk = 1
+        for c in self.commitment:
+            acc = add(acc, multiply(c, xk))
+            xk = xk * x % R
+        return PublicKeyShare(acc)
+
+    def verify_signature_share(
+        self, i: int, share: SignatureShare, msg: bytes
+    ) -> bool:
+        return self.public_key_share(i).verify(share, msg)
+
+    def combine_signatures(
+        self, shares: Mapping[int, SignatureShare]
+    ) -> Signature:
+        """Lagrange-combine >= t+1 verified shares (indexed by node i)."""
+        if len(shares) <= self.threshold:
+            raise ValueError(
+                f"need {self.threshold + 1} shares, got {len(shares)}"
+            )
+        pts = {i + 1: s.point for i, s in shares.items()}
+        return Signature(interpolate_g_at_zero(pts))
+
+    def decrypt(
+        self, shares: Mapping[int, DecryptionShare], ct: Ciphertext
+    ) -> bytes:
+        if len(shares) <= self.threshold:
+            raise ValueError(
+                f"need {self.threshold + 1} shares, got {len(shares)}"
+            )
+        pts = {i + 1: s.point for i, s in shares.items()}
+        g = interpolate_g_at_zero(pts)
+        return bytes(a ^ b for a, b in zip(ct.v, _kdf(g, len(ct.v))))
+
+    def to_bytes(self) -> bytes:
+        return b"".join(g1_to_bytes(c) for c in self.commitment)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PublicKeySet":
+        if len(raw) % 48:
+            raise ValueError("bad PublicKeySet encoding")
+        return cls(
+            [g1_from_bytes(raw[i : i + 48]) for i in range(0, len(raw), 48)]
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, PublicKeySet)
+            and len(self.commitment) == len(other.commitment)
+            and all(eq(a, b) for a, b in zip(self.commitment, other.commitment))
+        )
+
+    def __hash__(self):
+        return hash(self.to_bytes())

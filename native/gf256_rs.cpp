@@ -61,11 +61,4 @@ void gf256_matmul(const uint8_t* a, const uint8_t* b, uint8_t* out,
     }
   }
 }
-
-// Element-wise c = a * b over GF(2^8), length n.
-void gf256_mul_vec(const uint8_t* a, const uint8_t* b, uint8_t* out,
-                   int64_t n) {
-  for (int64_t j = 0; j < n; ++j) out[j] = kTables.mul[a[j]][b[j]];
-}
-
 }  // extern "C"

@@ -1,0 +1,81 @@
+"""BLS12-381 curve/pairing correctness."""
+import pytest
+
+from hydrabadger_tpu.crypto import bls12_381 as b
+
+
+def test_generators_on_curve():
+    assert b.is_on_curve(b.G1, b.B1)
+    assert b.is_on_curve(b.G2, b.B2)
+
+
+def test_generator_order():
+    assert b.is_inf(b.multiply(b.G1, b.R))
+    assert b.is_inf(b.multiply(b.G2, b.R))
+
+
+def test_group_laws_g1():
+    two = b.double(b.G1)
+    assert b.eq(two, b.multiply(b.G1, 2))
+    assert b.eq(b.add(two, b.G1), b.multiply(b.G1, 3))
+    assert b.eq(b.add(b.G1, b.infinity(b.FQ)), b.G1)
+    assert b.is_inf(b.add(b.G1, b.neg(b.G1)))
+    # (a+b)P == aP + bP
+    assert b.eq(
+        b.multiply(b.G1, 11 + 29), b.add(b.multiply(b.G1, 11), b.multiply(b.G1, 29))
+    )
+
+
+def test_group_laws_g2():
+    assert b.eq(b.add(b.double(b.G2), b.G2), b.multiply(b.G2, 3))
+    assert b.is_inf(b.add(b.G2, b.neg(b.G2)))
+
+
+def test_fq2_arith():
+    x = b.FQ2([3, 7])
+    assert x * x.inv() == b.FQ2.one()
+    s = (x * x).sqrt()
+    assert s == x or s == -x
+
+
+def test_fq12_arith():
+    x = b.FQ12(list(range(1, 13)))
+    assert x * x.inv() == b.FQ12.one()
+    assert x.conjugate().conjugate() == x
+
+
+def test_pairing_bilinearity():
+    e = b.pairing(b.G2, b.G1)
+    assert e != b.FQ12.one()
+    assert b.pairing(b.G2, b.multiply(b.G1, 3)) == e**3
+    assert b.pairing(b.multiply(b.G2, 5), b.G1) == e**5
+
+
+def test_pairing_check_eq():
+    s = 777
+    assert b.pairing_check_eq(
+        b.multiply(b.G1, s), b.G2, b.G1, b.multiply(b.G2, s)
+    )
+    assert not b.pairing_check_eq(
+        b.multiply(b.G1, s), b.G2, b.G1, b.multiply(b.G2, s + 1)
+    )
+
+
+def test_hash_to_g2_in_torsion():
+    h = b.hash_to_g2(b"hello")
+    assert b.is_on_curve(h, b.B2)
+    assert b.is_inf(b.multiply(h, b.R))
+    # deterministic + distinct
+    assert b.eq(h, b.hash_to_g2(b"hello"))
+    assert not b.eq(h, b.hash_to_g2(b"world"))
+
+
+def test_point_serialization():
+    pt = b.multiply(b.G1, 12345)
+    assert b.eq(b.g1_from_bytes(b.g1_to_bytes(pt)), pt)
+    q = b.multiply(b.G2, 54321)
+    assert b.eq(b.g2_from_bytes(b.g2_to_bytes(q)), q)
+    assert b.is_inf(b.g1_from_bytes(b.g1_to_bytes(b.infinity(b.FQ))))
+    assert b.is_inf(b.g2_from_bytes(b.g2_to_bytes(b.infinity(b.FQ2))))
+    with pytest.raises(ValueError):
+        b.g1_from_bytes(b"\x00" * 47)

@@ -1,0 +1,89 @@
+"""Distributed key generation end-to-end tests."""
+import random
+
+import pytest
+
+from hydrabadger_tpu.crypto import threshold as th
+from hydrabadger_tpu.crypto.dkg import BivarPoly, SyncKeyGen
+
+
+def run_dkg(n, t, seed=7, drop_proposer=None):
+    rng = random.Random(seed)
+    ids = [f"node{i}" for i in range(n)]
+    sks = {i: th.SecretKey.random(rng) for i in ids}
+    pks = {i: sks[i].public_key() for i in ids}
+    kgs = {
+        i: SyncKeyGen(i, sks[i], pks, t, random.Random(seed + 1 + k))
+        for k, i in enumerate(ids)
+    }
+    parts = {i: kgs[i].propose() for i in ids if i != drop_proposer}
+    acks = []
+    for receiver in ids:
+        for sender, part in parts.items():
+            out = kgs[receiver].handle_part(sender, part)
+            assert out.valid, out.fault
+            if out.ack is not None:
+                acks.append((receiver, out.ack))
+    for receiver in ids:
+        for acker, ack in acks:
+            out = kgs[receiver].handle_ack(acker, ack)
+            assert out.valid, out.fault
+    return ids, kgs, {i: kgs[i].generate() for i in ids}
+
+
+def test_dkg_produces_working_threshold_keys():
+    n, t = 3, 1
+    ids, kgs, results = run_dkg(n, t)
+    pk_sets = [r[0] for r in results.values()]
+    assert all(ps == pk_sets[0] for ps in pk_sets), "all nodes agree on pk_set"
+    pk_set = pk_sets[0]
+    for i in ids:
+        assert kgs[i].is_ready()
+    # shares actually work and different subsets agree
+    s1 = {
+        idx: results[ids[idx]][1].sign_share(b"dkg-coin") for idx in (0, 2)
+    }
+    s2 = {
+        idx: results[ids[idx]][1].sign_share(b"dkg-coin") for idx in (0, 1)
+    }
+    sig1 = pk_set.combine_signatures(s1)
+    sig2 = pk_set.combine_signatures(s2)
+    assert sig1 == sig2
+    assert pk_set.public_key().verify(sig1, b"dkg-coin")
+    # pk shares consistent with sk shares
+    for idx, i in enumerate(ids):
+        assert pk_set.public_key_share(idx) == results[i][1].public_key_share()
+
+
+def test_dkg_tolerates_missing_proposer():
+    """With one proposer silent, remaining proposals still yield keys."""
+    ids, kgs, results = run_dkg(3, 1, drop_proposer="node1")
+    pk_set = results[ids[0]][0]
+    shares = {
+        idx: results[ids[idx]][1].sign_share(b"m") for idx in (1, 2)
+    }
+    sig = pk_set.combine_signatures(shares)
+    assert pk_set.public_key().verify(sig, b"m")
+
+
+def test_bivar_poly_symmetry():
+    rng = random.Random(5)
+    p = BivarPoly.random(2, rng)
+    for x, y in [(1, 2), (3, 4), (5, 1)]:
+        assert p.evaluate(x, y) == p.evaluate(y, x)
+    row3 = p.row(3)
+    assert th.poly_eval(row3, 4) == p.evaluate(3, 4)
+
+
+def test_corrupt_part_rejected():
+    rng = random.Random(9)
+    ids = ["a", "b", "c"]
+    sks = {i: th.SecretKey.random(rng) for i in ids}
+    pks = {i: sks[i].public_key() for i in ids}
+    kg_a = SyncKeyGen("a", sks["a"], pks, 1, random.Random(1))
+    kg_b = SyncKeyGen("b", sks["b"], pks, 1, random.Random(2))
+    part = kg_a.propose()
+    # swap two encrypted rows: receiver decrypts a row that fails the commitment
+    tampered = type(part)(part.commit_bytes, (part.enc_rows[1], part.enc_rows[0]) + part.enc_rows[2:])
+    out = kg_b.handle_part("a", tampered)
+    assert not out.valid

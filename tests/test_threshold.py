@@ -1,0 +1,76 @@
+"""Threshold signature + encryption tests."""
+import random
+
+import pytest
+
+from hydrabadger_tpu.crypto import threshold as th
+
+
+@pytest.fixture(scope="module")
+def keyset():
+    rng = random.Random(42)
+    sks = th.SecretKeySet.random(1, rng)  # t=1: need 2 shares
+    return sks, sks.public_keys()
+
+
+def test_plain_signature(keyset):
+    rng = random.Random(1)
+    sk = th.SecretKey.random(rng)
+    pk = sk.public_key()
+    sig = sk.sign(b"msg")
+    assert pk.verify(sig, b"msg")
+    assert not pk.verify(sig, b"other")
+    assert th.Signature.from_bytes(sig.to_bytes()) == sig
+    assert th.PublicKey.from_bytes(pk.to_bytes()) == pk
+
+
+def test_threshold_signature_combination(keyset):
+    sks, pks = keyset
+    shares = {i: sks.secret_key_share(i).sign_share(b"coin0") for i in range(4)}
+    assert pks.verify_signature_share(2, shares[2], b"coin0")
+    assert not pks.verify_signature_share(1, shares[2], b"coin0")
+    c1 = pks.combine_signatures({1: shares[1], 3: shares[3]})
+    c2 = pks.combine_signatures({0: shares[0], 2: shares[2]})
+    assert c1 == c2, "combined sig independent of share subset"
+    assert pks.public_key().verify(c1, b"coin0")
+    assert c1 == sks.secret_key().sign(b"coin0")
+
+
+def test_combine_too_few_raises(keyset):
+    sks, pks = keyset
+    shares = {0: sks.secret_key_share(0).sign_share(b"x")}
+    with pytest.raises(ValueError):
+        pks.combine_signatures(shares)
+
+
+def test_threshold_encryption(keyset):
+    sks, pks = keyset
+    rng = random.Random(2)
+    ct = pks.public_key().encrypt(b"secret payload", rng)
+    assert ct.verify()
+    shares = {i: sks.secret_key_share(i).decrypt_share(ct) for i in (0, 3)}
+    assert pks.public_key_share(0).verify_decryption_share(shares[0], ct)
+    assert pks.decrypt(shares, ct) == b"secret payload"
+    assert sks.secret_key().decrypt(ct) == b"secret payload"
+    assert th.Ciphertext.from_bytes(ct.to_bytes()) == ct
+
+
+def test_tampered_ciphertext_rejected(keyset):
+    sks, pks = keyset
+    rng = random.Random(3)
+    ct = pks.public_key().encrypt(b"payload", rng)
+    bad = th.Ciphertext(ct.u, bytes([ct.v[0] ^ 1]) + ct.v[1:], ct.w)
+    assert not bad.verify()
+    assert sks.secret_key().decrypt(bad) is None
+
+
+def test_public_key_set_roundtrip(keyset):
+    _, pks = keyset
+    assert th.PublicKeySet.from_bytes(pks.to_bytes()) == pks
+
+
+def test_lagrange_interpolation():
+    rng = random.Random(4)
+    coeffs = th.poly_random(3, rng)
+    pts = {x: th.poly_eval(coeffs, x) for x in (2, 5, 9, 11)}
+    assert th.poly_interpolate_at_zero(pts) == coeffs[0]

@@ -1,0 +1,185 @@
+"""Reliable Broadcast (RBC): Bracha broadcast over RS-coded payloads.
+
+Semantics follow hbbft's `broadcast` module — the protocol the reference
+reaches through DynamicHoneyBadger (SURVEY.md §2.2 row 2): the proposer
+Reed-Solomon-codes its value into N shards (N-2f data, 2f parity), binds
+them with a Merkle tree, and sends each node its proof.  Nodes Echo their
+proofs to everyone, send Ready on N-f echoes (or f+1 readys), and decode
+once 2f+1 readys + N-2f echoes are in.  Every multicast is self-handled,
+so `Target.all()` means "all *other* nodes" to the transport.
+
+This per-instance core is intentionally scalar; the TPU path batches the
+RS encode/decode of many instances through ops/rs_jax (SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+from typing import Dict, Hashable, Optional, TypeVar
+
+from ..crypto.rs import ReedSolomon
+from .merkle import MerkleTree, Proof
+from .types import NetworkInfo, Step, Target
+
+N = TypeVar("N", bound=Hashable)
+
+MSG_VALUE = "bc_value"
+MSG_ECHO = "bc_echo"
+MSG_READY = "bc_ready"
+
+
+class Broadcast:
+    """One broadcast instance: `proposer_id` disseminates one payload."""
+
+    def __init__(self, netinfo: NetworkInfo, proposer_id):
+        self.netinfo = netinfo
+        self.proposer_id = proposer_id
+        n, f = netinfo.num_nodes, netinfo.num_faulty
+        self.data_shards = n - 2 * f
+        self.parity_shards = 2 * f
+        self.rs = ReedSolomon(self.data_shards, self.parity_shards)
+        self.echo_sent = False
+        self.ready_sent = False
+        self.decided = False
+        self.payload: Optional[bytes] = None  # set when decoding succeeds
+        self.value_received = False
+        self.echos: Dict = {}  # sender -> Proof
+        self.readys: Dict = {}  # sender -> root bytes
+        self.fault_estimate = 0
+
+    # -- API ----------------------------------------------------------------
+
+    def broadcast(self, payload: bytes, rng=None) -> Step:
+        """Proposer entry point: shard, prove, disseminate."""
+        if self.netinfo.our_id != self.proposer_id:
+            raise ValueError("only the proposer may broadcast")
+        if self.value_received:
+            return Step.empty()
+        shards = self.rs.encode_bytes(payload)
+        tree = MerkleTree(shards)
+        step = Step()
+        my_proof = None
+        for i, nid in enumerate(self.netinfo.node_ids):
+            proof = tree.proof(i)
+            if nid == self.netinfo.our_id:
+                my_proof = proof
+            else:
+                step.to(nid, (MSG_VALUE, proof.wire()))
+        self.value_received = True
+        if my_proof is not None:
+            step.extend(self._send_echo(my_proof))
+        return step
+
+    def handle_message(self, sender, message) -> Step:
+        kind, payload = message[0], message[1]
+        if kind == MSG_VALUE:
+            return self._handle_value(sender, Proof.from_wire(payload))
+        if kind == MSG_ECHO:
+            return self._handle_echo(sender, Proof.from_wire(payload))
+        if kind == MSG_READY:
+            return self._handle_ready(sender, bytes(payload))
+        return Step().fault(sender, f"broadcast: unknown message {kind!r}")
+
+    # -- internals ----------------------------------------------------------
+
+    def _n_leaves(self) -> int:
+        return self.netinfo.num_nodes
+
+    def _handle_value(self, sender, proof: Proof) -> Step:
+        if sender != self.proposer_id:
+            return Step().fault(sender, "broadcast: Value from non-proposer")
+        if self.value_received:
+            return Step()
+        our_idx = self.netinfo.index(self.netinfo.our_id)
+        if proof.index != our_idx or not proof.validate(self._n_leaves()):
+            return Step().fault(sender, "broadcast: invalid Value proof")
+        self.value_received = True
+        return self._send_echo(proof)
+
+    def _send_echo(self, proof: Proof) -> Step:
+        if self.echo_sent:
+            return Step()
+        self.echo_sent = True
+        step = Step().broadcast((MSG_ECHO, proof.wire()))
+        return step.extend(self._handle_echo(self.netinfo.our_id, proof))
+
+    def _handle_echo(self, sender, proof: Proof) -> Step:
+        if sender in self.echos:
+            prev = self.echos[sender]
+            if prev.wire() != proof.wire():
+                return Step().fault(sender, "broadcast: conflicting Echo")
+            return Step()
+        expected_idx = self.netinfo.index(sender)
+        if proof.index != expected_idx or not proof.validate(self._n_leaves()):
+            return Step().fault(sender, "broadcast: invalid Echo proof")
+        self.echos[sender] = proof
+        step = Step()
+        n, f = self.netinfo.num_nodes, self.netinfo.num_faulty
+        root = proof.root
+        if self._count_echos(root) >= n - f and not self.ready_sent:
+            step.extend(self._send_ready(root))
+        if (
+            self._count_readys(root) >= 2 * f + 1
+            and self._count_echos(root) >= self.data_shards
+        ):
+            step.extend(self._try_decode(root))
+        return step
+
+    def _send_ready(self, root: bytes) -> Step:
+        if self.ready_sent:
+            return Step()
+        self.ready_sent = True
+        step = Step().broadcast((MSG_READY, root))
+        return step.extend(self._handle_ready(self.netinfo.our_id, root))
+
+    def _handle_ready(self, sender, root: bytes) -> Step:
+        if sender in self.readys:
+            if self.readys[sender] != root:
+                return Step().fault(sender, "broadcast: conflicting Ready")
+            return Step()
+        self.readys[sender] = root
+        step = Step()
+        f = self.netinfo.num_faulty
+        if self._count_readys(root) >= f + 1 and not self.ready_sent:
+            step.extend(self._send_ready(root))
+        if (
+            self._count_readys(root) >= 2 * f + 1
+            and self._count_echos(root) >= self.data_shards
+        ):
+            step.extend(self._try_decode(root))
+        return step
+
+    def _count_echos(self, root: bytes) -> int:
+        return sum(1 for p in self.echos.values() if p.root == root)
+
+    def _count_readys(self, root: bytes) -> int:
+        return sum(1 for r in self.readys.values() if r == root)
+
+    def _try_decode(self, root: bytes) -> Step:
+        if self.decided:
+            return Step()
+        slots = [None] * self.netinfo.num_nodes
+        for sender, proof in self.echos.items():
+            if proof.root == root:
+                slots[proof.index] = proof.value
+        try:
+            payload = self.rs.reconstruct_data(slots)
+        except ValueError:
+            return Step().fault(
+                self.proposer_id, "broadcast: undecodable shards"
+            )
+        # Recompute the tree: catches a proposer whose shards don't form a
+        # consistent coding (split-root attack).
+        full = ReedSolomon(self.data_shards, self.parity_shards).encode_bytes(
+            payload
+        )
+        if MerkleTree(full).root != root:
+            self.decided = True
+            return Step().fault(self.proposer_id, "broadcast: root mismatch")
+        self.decided = True
+        self.payload = payload
+        step = Step()
+        step.output.append(payload)
+        return step
+
+    @property
+    def terminated(self) -> bool:
+        return self.decided

@@ -1,0 +1,513 @@
+"""DynamicHoneyBadger: HoneyBadger with a dynamic validator set.
+
+hbbft's `dynamic_honey_badger` equivalent — the protocol the reference
+actually instantiates (/root/reference/src/hydrabadger/state.rs:213,297-299;
+type aliases lib.rs:182-184).  Capabilities mirrored:
+
+  - `vote_for(change)` — signed Add/Remove votes ride inside contributions
+    and are tallied once *committed*, so every node sees the same tally
+    (votes at handler.rs:84,421).
+  - key generation by consensus: once a change wins a majority, a
+    SyncKeyGen session for the new validator set runs with its Part/Ack
+    messages embedded in committed contributions — totally ordered, so
+    all nodes step the DKG identically.  A node being added participates
+    passively: its rows/values are decryptable from the committed
+    transcript, so it derives its share without sending anything.
+  - eras: when the committed DKG transcript is ready, everyone switches
+    to a fresh HoneyBadger over the new `NetworkInfo` at the same epoch;
+    `Batch.change` reports `InProgress` / `Complete` (ChangeState at
+    handler.rs:698-715).
+  - join plans: batches at change-commit points carry a `JoinPlan` enough
+    for a fresh node to come up as an *observer* (state.rs:200-250); it
+    is promoted when a later committed change includes it.
+
+Sender attribution for votes / DKG messages comes from the ACS slot of
+the contribution that carried them (each slot is bound to its proposer by
+Broadcast), plus an explicit signature on votes so they cannot be forged
+by a relaying proposer.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Hashable, List, Optional, Tuple, TypeVar
+
+from ..crypto.dkg import Ack, Part, SyncKeyGen
+from ..crypto.threshold import PublicKey, PublicKeySet, SecretKey, SecretKeyShare
+from ..utils import codec
+from .honey_badger import Batch, HoneyBadger
+from .types import NetworkInfo, Step
+
+N = TypeVar("N", bound=Hashable)
+
+MSG = "dhb"
+
+
+# -- changes ----------------------------------------------------------------
+
+
+def change_add(node_id, pub_key: PublicKey) -> tuple:
+    return ("add", node_id, pub_key.to_bytes())
+
+
+def change_remove(node_id) -> tuple:
+    return ("remove", node_id)
+
+
+@dataclass(frozen=True)
+class JoinPlan:
+    era: int
+    epoch: int  # first epoch the observer will see
+    node_ids: tuple
+    pub_keys: dict  # node_id -> pk bytes (all known nodes)
+    pk_set_bytes: bytes
+    session_id: bytes
+
+    def wire(self) -> tuple:
+        return (
+            self.era,
+            self.epoch,
+            tuple(self.node_ids),
+            {k: v for k, v in sorted(self.pub_keys.items())},
+            self.pk_set_bytes,
+            self.session_id,
+        )
+
+    @classmethod
+    def from_wire(cls, w) -> "JoinPlan":
+        era, epoch, node_ids, pub_keys, pk_set_bytes, session_id = w
+        return cls(
+            int(era),
+            int(epoch),
+            tuple(node_ids),
+            dict(pub_keys),
+            bytes(pk_set_bytes),
+            bytes(session_id),
+        )
+
+
+@dataclass(frozen=True)
+class DhbBatch:
+    """An epoch's output: contributions + membership-change progress."""
+
+    epoch: int
+    era: int
+    contributions: dict  # proposer -> user payload bytes
+    change: Optional[tuple] = None  # ("in_progress"|"complete", change)
+    join_plan: Optional[JoinPlan] = None
+
+
+@dataclass
+class _KeyGenState:
+    change: tuple
+    new_ids: list
+    new_pub_keys: dict
+    key_gen: SyncKeyGen
+    our_part_queued: bool = False
+    parts_seen: set = None
+
+    def __post_init__(self):
+        if self.parts_seen is None:
+            self.parts_seen = set()
+
+
+class DynamicHoneyBadger:
+    def __init__(
+        self,
+        our_id,
+        our_sk: SecretKey,
+        netinfo: NetworkInfo,
+        pub_keys: Dict,
+        era: int = 0,
+        epoch: Optional[int] = None,
+        session_id: bytes = b"dhb",
+        encrypt: bool = True,
+        coin_mode: str = "threshold",
+        verify_shares: bool = True,
+        rng=None,
+    ):
+        self.our_id = our_id
+        self.our_sk = our_sk
+        self.netinfo = netinfo
+        self.pub_keys = dict(pub_keys)  # all known nodes incl. observers
+        self.era = era
+        self.epoch = era if epoch is None else epoch  # absolute epoch counter
+        self.session_id = bytes(session_id)
+        self.encrypt = encrypt
+        self.coin_mode = coin_mode
+        self.verify_shares = verify_shares
+        self.rng = rng
+        self.hb = self._make_hb()
+        self.votes: Dict = {}  # voter -> change (latest committed vote)
+        self.our_vote: Optional[tuple] = None
+        self.vote_queued = False
+        self.key_gen: Optional[_KeyGenState] = None
+        self.out_kg: List[tuple] = []  # queued keygen msgs for next contribution
+        self.batches: List[DhbBatch] = []
+        # messages for eras we haven't reached yet (rushed peers); replayed
+        # after each era switch so their era-start proposals aren't lost
+        self.future_msgs: List[tuple] = []
+        self._just_switched = False
+
+    # -- construction helpers ----------------------------------------------
+
+    def _make_hb(self) -> HoneyBadger:
+        return HoneyBadger(
+            self.netinfo,
+            session_id=self.session_id + b"/era" + str(self.era).encode(),
+            encrypt=self.encrypt,
+            coin_mode=self.coin_mode,
+            verify_shares=self.verify_shares,
+        )
+
+    @classmethod
+    def from_join_plan(
+        cls,
+        our_id,
+        our_sk: SecretKey,
+        plan: JoinPlan,
+        encrypt: bool = True,
+        coin_mode: str = "threshold",
+        verify_shares: bool = True,
+        rng=None,
+    ) -> "DynamicHoneyBadger":
+        """Instantiate as an observer from a committed JoinPlan
+        (the reference's `new_joining` path, state.rs:200-250)."""
+        pub_keys = {
+            nid: PublicKey.from_bytes(bytes(pk))
+            for nid, pk in plan.pub_keys.items()
+        }
+        pk_set = PublicKeySet.from_bytes(plan.pk_set_bytes)
+        netinfo = NetworkInfo(our_id, list(plan.node_ids), pk_set, None)
+        dhb = cls(
+            our_id,
+            our_sk,
+            netinfo,
+            pub_keys,
+            era=plan.era,
+            epoch=plan.epoch,
+            session_id=plan.session_id,
+            encrypt=encrypt,
+            coin_mode=coin_mode,
+            verify_shares=verify_shares,
+            rng=rng,
+        )
+        dhb.hb.epoch = plan.epoch - plan.era  # skip the era's earlier epochs
+        return dhb
+
+    # -- API ----------------------------------------------------------------
+
+    @property
+    def is_validator(self) -> bool:
+        return self.netinfo.is_validator() and self.netinfo.sk_share is not None
+
+    def vote_for(self, change: tuple) -> Step:
+        """Queue our signed vote; it ships with the next contribution."""
+        self.our_vote = tuple(change)
+        self.vote_queued = True
+        return Step()
+
+    def vote_to_add(self, node_id, pub_key: PublicKey) -> Step:
+        return self.vote_for(change_add(node_id, pub_key))
+
+    def vote_to_remove(self, node_id) -> Step:
+        return self.vote_for(change_remove(node_id))
+
+    def propose(self, contribution: bytes, rng) -> Step:
+        if not self.is_validator:
+            return Step()
+        votes = []
+        if self.vote_queued and self.our_vote is not None:
+            sig = self.our_sk.sign(self._vote_doc(self.our_vote))
+            votes.append((self.our_id, self.our_vote, sig.to_bytes()))
+            self.vote_queued = False
+        kg_msgs = self.out_kg
+        self.out_kg = []
+        internal = codec.encode(
+            (bytes(contribution), tuple(votes), tuple(kg_msgs))
+        )
+        step = self.hb.propose(internal, rng)
+        return self._filter(step)
+
+    def handle_message(self, sender, message) -> Step:
+        _tag, era, inner = message[0], int(message[1]), message[2]
+        if era > self.era:
+            # a peer that committed the era-switch batch before us; buffer so
+            # its era-start traffic survives until we switch too
+            if len(self.future_msgs) < 10_000:
+                self.future_msgs.append((era, sender, message))
+            return Step()
+        if era < self.era:
+            return Step()  # stale era, outcome already absorbed
+        step = self.hb.handle_message(sender, inner)
+        return self._filter(step)
+
+    def join_plan(self) -> JoinPlan:
+        return JoinPlan(
+            era=self.era,
+            epoch=self.epoch,
+            node_ids=tuple(self.netinfo.node_ids),
+            pub_keys={
+                nid: pk.to_bytes() for nid, pk in self.pub_keys.items()
+            },
+            pk_set_bytes=self.netinfo.pk_set.to_bytes(),
+            session_id=self.session_id,
+        )
+
+    # -- internals ----------------------------------------------------------
+
+    def _vote_doc(self, change: tuple) -> bytes:
+        return b"DHB-VOTE" + codec.encode((self.era, tuple(change)))
+
+    def _filter(self, step: Step) -> Step:
+        """Relabel era-scoped messages and post-process batches."""
+        step.map_messages(lambda m: (MSG, self.era, m))
+        out = []
+        faults = []
+        for item in step.output:
+            if isinstance(item, Batch):
+                batch, fstep = self._on_batch(item)
+                out.append(batch)
+                faults.append(fstep)
+        step.output = out
+        for f in faults:
+            step.extend(f)
+        # after an era switch, replay buffered traffic for the new era
+        while self._just_switched:
+            self._just_switched = False
+            pending, self.future_msgs = self.future_msgs, []
+            for era, sender, message in pending:
+                if era > self.era:
+                    self.future_msgs.append((era, sender, message))
+                elif era == self.era:
+                    step.extend(self.handle_message(sender, message))
+        return step
+
+    def _on_batch(self, hb_batch: Batch) -> Tuple[DhbBatch, Step]:
+        step = Step()
+        contributions = {}
+        for proposer, payload in sorted(hb_batch.contributions.items()):
+            try:
+                user, votes, kg_msgs = codec.decode(bytes(payload))
+            except (ValueError, TypeError):
+                step.fault(proposer, "dhb: malformed contribution")
+                continue
+            contributions[proposer] = bytes(user)
+            for vote in votes:
+                self._commit_vote(proposer, vote, step)
+            for kg in kg_msgs:
+                self._commit_keygen_msg(proposer, kg, step)
+        self.epoch = self.era + hb_batch.epoch + 1
+        change = None
+        join_plan = None
+        # start keygen once a change wins a committed majority
+        if self.key_gen is None:
+            winner = self._winning_change()
+            if winner is not None:
+                self._start_key_gen(winner)
+        if self.key_gen is not None:
+            if self._keygen_ready():
+                change = ("complete", self.key_gen.change)
+            else:
+                change = ("in_progress", self.key_gen.change)
+        era_switched = False
+        if change is not None and change[0] == "complete":
+            era_switched = True
+        batch = DhbBatch(
+            epoch=self.epoch - 1,
+            era=self.era,
+            contributions=contributions,
+            change=change,
+        )
+        if era_switched:
+            self._switch_era(step)
+            batch = DhbBatch(
+                epoch=batch.epoch,
+                era=batch.era,
+                contributions=batch.contributions,
+                change=batch.change,
+                join_plan=self.join_plan(),
+            )
+        self.batches.append(batch)
+        return batch, step
+
+    def _commit_vote(self, proposer, vote, step: Step) -> None:
+        try:
+            voter, change, sig_bytes = vote
+            change = tuple(change)
+            from ..crypto.threshold import Signature
+
+            sig = Signature.from_bytes(bytes(sig_bytes))
+        except (ValueError, TypeError):
+            step.fault(proposer, "dhb: malformed vote")
+            return
+        pk = self.pub_keys.get(voter)
+        if pk is None or voter not in self.netinfo._index:
+            step.fault(proposer, "dhb: vote from non-validator")
+            return
+        if not pk.verify(sig, self._vote_doc(change)):
+            step.fault(proposer, "dhb: bad vote signature")
+            return
+        self.votes[voter] = change
+
+    def _keygen_ready(self) -> bool:
+        """Deterministic era-switch gate, evaluated on committed data only:
+        more than `threshold` proposals complete.  (The strict all-n gate of
+        the bootstrap keygen, key_gen.rs:373-386, cannot work here — a node
+        being *added* observes the transcript but never proposes a Part.)
+        """
+        state = self.key_gen
+        t = (len(state.new_ids) - 1) // 3
+        return state.key_gen.count_complete() > t
+
+    def _winning_change(self) -> Optional[tuple]:
+        counts: Dict[tuple, int] = {}
+        for change in self.votes.values():
+            counts[change] = counts.get(change, 0) + 1
+        n = self.netinfo.num_nodes
+        for change, count in sorted(counts.items(), key=lambda kv: -kv[1]):
+            if count * 2 > n:
+                return change
+        return None
+
+    def _start_key_gen(self, change: tuple) -> None:
+        if change[0] == "add":
+            node_id, pk_bytes = change[1], bytes(change[2])
+            new_ids = sorted(set(self.netinfo.node_ids) | {node_id})
+            new_pub_keys = {
+                nid: self.pub_keys[nid]
+                for nid in self.netinfo.node_ids
+                if nid in self.pub_keys
+            }
+            new_pub_keys[node_id] = PublicKey.from_bytes(pk_bytes)
+            self.pub_keys.setdefault(node_id, new_pub_keys[node_id])
+        else:
+            node_id = change[1]
+            new_ids = sorted(set(self.netinfo.node_ids) - {node_id})
+            new_pub_keys = {
+                nid: self.pub_keys[nid] for nid in new_ids if nid in self.pub_keys
+            }
+        if self.our_id in new_ids:
+            threshold = (len(new_ids) - 1) // 3
+            kg = SyncKeyGen(
+                self.our_id, self.our_sk, new_pub_keys, threshold, self.rng
+            )
+            state = _KeyGenState(tuple(change), new_ids, new_pub_keys, kg)
+            self.key_gen = state
+            if self.is_validator:
+                part = kg.propose()
+                self.out_kg.append(
+                    ("part", part.commit_bytes, tuple(part.enc_rows))
+                )
+                state.our_part_queued = True
+        else:
+            # we are being removed: follow the transcript without a DKG role
+            self.key_gen = _KeyGenState(
+                tuple(change), new_ids, new_pub_keys, _RemovedTracker(new_ids)
+            )
+
+    def _commit_keygen_msg(self, proposer, kg, step: Step) -> None:
+        state = self.key_gen
+        if state is None:
+            return  # no active keygen: stale message
+        try:
+            kind = kg[0]
+            if kind == "part":
+                part = Part(bytes(kg[1]), tuple(bytes(r) for r in kg[2]))
+                outcome = state.key_gen.handle_part(proposer, part)
+                if outcome is None:
+                    return
+                if not outcome.valid:
+                    step.fault(proposer, f"dhb keygen: {outcome.fault}")
+                elif outcome.ack is not None and self.is_validator:
+                    self.out_kg.append(
+                        (
+                            "ack",
+                            outcome.ack.proposer_idx,
+                            tuple(outcome.ack.enc_values),
+                        )
+                    )
+            elif kind == "ack":
+                ack = Ack(int(kg[1]), tuple(bytes(v) for v in kg[2]))
+                outcome = state.key_gen.handle_ack(proposer, ack)
+                if outcome is not None and not outcome.valid:
+                    step.fault(proposer, f"dhb keygen: {outcome.fault}")
+            else:
+                step.fault(proposer, "dhb: unknown keygen message")
+        except (ValueError, TypeError, KeyError):
+            step.fault(proposer, "dhb: malformed keygen message")
+
+    def _switch_era(self, step: Step) -> None:
+        state = self.key_gen
+        new_era = self.epoch
+        if isinstance(state.key_gen, _RemovedTracker):
+            pk_set, sk_share = state.key_gen.generate(), None
+        else:
+            pk_set, sk_share = state.key_gen.generate()
+        if self.our_id not in state.new_ids:
+            sk_share = None
+        self.netinfo = NetworkInfo(
+            self.our_id, state.new_ids, pk_set, sk_share
+        )
+        self.pub_keys = dict(state.new_pub_keys)
+        self.era = new_era
+        self.hb = self._make_hb()
+        self.votes = {}
+        self.key_gen = None
+        self.out_kg = []
+        self.vote_queued = False
+        self._just_switched = True
+
+
+class _RemovedTracker:
+    """DKG transcript follower for a node *leaving* the validator set.
+
+    It cannot decrypt rows/values, so it mirrors SyncKeyGen's completion
+    accounting structurally (one value per committed ack) to fire the
+    same era-switch gate at the same batch, and reconstructs the public
+    key set from the committed commitments alone.  Assumes committed acks
+    are honest (the validators verify them cryptographically; a bad ack
+    would be flagged there).
+    """
+
+    def __init__(self, new_ids):
+        self.new_ids = sorted(new_ids)
+        self.threshold = (len(self.new_ids) - 1) // 3
+        self.commitments: Dict[int, object] = {}  # proposer idx -> commitment
+        self.ack_counts: Dict[int, set] = {}
+
+    def handle_part(self, sender_id, part: Part):
+        from ..crypto.dkg import BivarCommitment, PartOutcome
+
+        if sender_id not in self.new_ids:
+            return PartOutcome(False, fault="part from non-member")
+        idx = self.new_ids.index(sender_id)
+        if idx not in self.commitments:
+            self.commitments[idx] = BivarCommitment.from_bytes(part.commit_bytes)
+            self.ack_counts[idx] = set()
+        return PartOutcome(True)
+
+    def handle_ack(self, sender_id, ack: Ack):
+        from ..crypto.dkg import AckOutcome
+
+        if ack.proposer_idx in self.ack_counts and sender_id in self.new_ids:
+            self.ack_counts[ack.proposer_idx].add(sender_id)
+        return AckOutcome(True)
+
+    def _complete(self):
+        return [
+            i
+            for i in sorted(self.commitments)
+            if len(self.ack_counts.get(i, ())) > self.threshold
+        ]
+
+    def count_complete(self) -> int:
+        return len(self._complete())
+
+    def generate(self) -> PublicKeySet:
+        from ..crypto.bls12_381 import add as g_add
+
+        acc = None
+        for idx in self._complete():
+            row0 = self.commitments[idx].row_commitment(0)
+            acc = row0 if acc is None else [g_add(a, b) for a, b in zip(acc, row0)]
+        return PublicKeySet(acc)

@@ -1,0 +1,105 @@
+"""QueueingHoneyBadger: a transaction queue feeding HoneyBadger epochs.
+
+hbbft's `queueing_honey_badger` equivalent (the type the reference's
+BASELINE north star batches by the thousand).  Transactions are pushed
+into a local queue; each epoch proposes a bounded random sample from the
+queue front (randomisation de-correlates proposers so the union covers
+the queue), and committed transactions are pruned everywhere.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Hashable, List, Optional, TypeVar
+
+from ..utils import codec
+from .honey_badger import Batch, HoneyBadger
+from .types import NetworkInfo, Step
+
+N = TypeVar("N", bound=Hashable)
+
+
+class QueueingHoneyBadger:
+    def __init__(
+        self,
+        netinfo: NetworkInfo,
+        batch_size: int = 100,
+        session_id: bytes = b"qhb",
+        encrypt: bool = True,
+        coin_mode: str = "threshold",
+        verify_shares: bool = True,
+    ):
+        self.netinfo = netinfo
+        self.batch_size = max(1, batch_size)
+        self.queue: "OrderedDict[bytes, None]" = OrderedDict()
+        self.hb = HoneyBadger(
+            netinfo,
+            session_id=session_id,
+            encrypt=encrypt,
+            coin_mode=coin_mode,
+            verify_shares=verify_shares,
+        )
+        self.batches: List[Batch] = []
+
+    # -- API ----------------------------------------------------------------
+
+    def push_transaction(self, txn: bytes, rng=None) -> Step:
+        """Queue a transaction; kicks off an epoch if none is in flight."""
+        self.queue[bytes(txn)] = None
+        if rng is not None:
+            return self._maybe_propose(rng)
+        return Step()
+
+    def handle_message(self, sender, message) -> Step:
+        step = self.hb.handle_message(sender, message)
+        return self._filter(step)
+
+    def force_propose(self, rng) -> Step:
+        """Propose for the current epoch even if the queue is empty."""
+        return self._filter(self._propose(rng))
+
+    # -- internals ----------------------------------------------------------
+
+    def _sample(self, rng) -> List[bytes]:
+        """Random sample of the queue front (avalanche-avoidance: sample
+        batch_size items from the first `batch_size * num_nodes`)."""
+        window = list(self.queue.keys())[
+            : self.batch_size * max(1, self.netinfo.num_nodes)
+        ]
+        per_node = max(1, self.batch_size // max(1, self.netinfo.num_nodes))
+        if len(window) <= per_node:
+            return window
+        return rng.sample(window, per_node)
+
+    def _propose(self, rng) -> Step:
+        contribution = codec.encode(tuple(self._sample(rng)))
+        return self.hb.propose(contribution, rng)
+
+    def _maybe_propose(self, rng) -> Step:
+        if self.hb.has_input.get(self.hb.epoch):
+            return Step()
+        return self._filter(self._propose(rng))
+
+    def _filter(self, step: Step) -> Step:
+        """Decode committed contributions, prune the queue, re-emit batches."""
+        out = []
+        for item in step.output:
+            if not isinstance(item, Batch):
+                continue
+            contributions = {}
+            for proposer, payload in item.contributions.items():
+                try:
+                    txns = [bytes(t) for t in codec.decode(bytes(payload))]
+                except (ValueError, TypeError):
+                    continue  # malformed contribution: proposer's loss
+                contributions[proposer] = txns
+                for t in txns:
+                    self.queue.pop(t, None)
+            batch = Batch(item.epoch, contributions)
+            self.batches.append(batch)
+            out.append(batch)
+        step.output = out
+        return step
+
+    @property
+    def epoch(self) -> int:
+        return self.hb.epoch

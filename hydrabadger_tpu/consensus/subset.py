@@ -1,0 +1,140 @@
+"""Subset (Asynchronous Common Subset, ACS).
+
+hbbft's `subset` equivalent (SURVEY.md §2.2 row 2): one Reliable
+Broadcast per proposer disseminates contributions; one Binary Agreement
+per proposer decides membership.  A proposer's slot enters the subset
+when its ABA decides 1; once N-f slots have decided 1, the node votes 0
+for every remaining slot.  The final output — identical at every correct
+node — is the set of (proposer, payload) pairs whose ABA decided 1.
+
+All N broadcast + N ABA instances per node are independent state
+machines: the batchable axis the TPU engine exploits (SURVEY.md §2.3's
+(instances x nodes x epochs x shards) batch shape).
+"""
+from __future__ import annotations
+
+from typing import Dict, Hashable, Optional, TypeVar
+
+from .binary_agreement import BinaryAgreement
+from .broadcast import Broadcast
+from .types import NetworkInfo, Step
+
+N = TypeVar("N", bound=Hashable)
+
+MSG = "cs"
+
+
+class Subset:
+    def __init__(
+        self,
+        netinfo: NetworkInfo,
+        session_id: bytes,
+        coin_mode: str = "threshold",
+        verify_coin_shares: bool = True,
+    ):
+        self.netinfo = netinfo
+        self.session_id = bytes(session_id)
+        self.broadcasts: Dict = {
+            nid: Broadcast(netinfo, nid) for nid in netinfo.node_ids
+        }
+        self.agreements: Dict = {
+            nid: BinaryAgreement(
+                netinfo,
+                self.session_id + b"/" + str(i).encode(),
+                coin_mode=coin_mode,
+                verify_coin_shares=verify_coin_shares,
+            )
+            for i, nid in enumerate(netinfo.node_ids)
+        }
+        self.broadcast_results: Dict = {}
+        self.ba_results: Dict = {}
+        self.decided = False
+        self.result: Optional[dict] = None
+
+    # -- API ----------------------------------------------------------------
+
+    def propose(self, value: bytes) -> Step:
+        """Contribute our payload (validators only)."""
+        bc = self.broadcasts.get(self.netinfo.our_id)
+        if bc is None:
+            return Step()
+        step = bc.broadcast(value).map_messages(
+            lambda m: self._wrap(self.netinfo.our_id, m)
+        )
+        step.output.clear()
+        return Step().extend(step).extend(self._progress())
+
+    def handle_message(self, sender, message) -> Step:
+        _tag, pidx, inner = message[0], int(message[1]), message[2]
+        if not 0 <= pidx < self.netinfo.num_nodes:
+            return Step().fault(sender, "subset: bad proposer index")
+        proposer = self.netinfo.node_ids[pidx]
+        step = Step()
+        if inner[0].startswith("bc_"):
+            sub = self.broadcasts[proposer].handle_message(sender, inner)
+        elif inner[0] == "ba":
+            sub = self.agreements[proposer].handle_message(sender, inner)
+        else:
+            return step.fault(sender, f"subset: unknown inner {inner[0]!r}")
+        step.extend(self._relabel(proposer, sub))
+        step.extend(self._progress())
+        return step
+
+    # -- internals ----------------------------------------------------------
+
+    def _wrap(self, proposer, message) -> tuple:
+        return (MSG, self.netinfo.index(proposer), message)
+
+    def _relabel(self, proposer, sub: Step) -> Step:
+        """Tag a sub-protocol step's messages; consume its outputs."""
+        sub.map_messages(lambda m: self._wrap(proposer, m))
+        sub.output.clear()
+        return sub
+
+    def _progress(self) -> Step:
+        """Drive cross-instance rules; idempotent."""
+        step = Step()
+        # capture broadcast payloads
+        for nid, bc in self.broadcasts.items():
+            if nid not in self.broadcast_results and bc.terminated:
+                payload = bc.payload
+                if payload is not None:
+                    self.broadcast_results[nid] = payload
+                    ba = self.agreements[nid]
+                    if ba.estimate is None and not ba.terminated:
+                        step.extend(
+                            self._relabel(nid, ba.propose(True))
+                        )
+        # capture ABA decisions
+        for nid, ba in self.agreements.items():
+            if nid not in self.ba_results and ba.terminated:
+                self.ba_results[nid] = ba.decision
+        # N-f slots accepted: vote 0 everywhere else
+        accepted = sum(1 for v in self.ba_results.values() if v)
+        if accepted >= self.netinfo.num_correct:
+            for nid, ba in self.agreements.items():
+                if ba.estimate is None and not ba.terminated:
+                    step.extend(self._relabel(nid, ba.propose(False)))
+        # completion: all ABAs decided, and payloads present for accepted
+        if not self.decided and len(self.ba_results) == self.netinfo.num_nodes:
+            pending = [
+                nid
+                for nid, dec in self.ba_results.items()
+                if dec and nid not in self.broadcast_results
+            ]
+            if not pending:
+                self.decided = True
+                self.result = {
+                    nid: self.broadcast_results[nid]
+                    for nid, dec in sorted(self.ba_results.items())
+                    if dec
+                }
+                step.output.append(self.result)
+        # newly-produced sub-steps may have terminated more instances
+        if step.messages and not self.decided:
+            step.extend(self._progress())
+        return step
+
+    @property
+    def terminated(self) -> bool:
+        return self.decided

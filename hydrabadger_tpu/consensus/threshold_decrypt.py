@@ -1,0 +1,85 @@
+"""ThresholdDecrypt: collaborative decryption of one ciphertext.
+
+hbbft's `threshold_decrypt` equivalent — HoneyBadger's output stage
+decrypts each agreed contribution this way (SURVEY.md §3.3 hot loop).
+Share verify + Lagrange combine are the BLS kernels BASELINE.json
+designates for TPU batching (shares/sec metric).
+"""
+from __future__ import annotations
+
+from typing import Dict, Hashable, Optional, TypeVar
+
+from ..crypto.threshold import Ciphertext, DecryptionShare
+from .types import NetworkInfo, Step
+
+N = TypeVar("N", bound=Hashable)
+
+MSG_DEC_SHARE = "td_share"
+
+
+class ThresholdDecrypt:
+    def __init__(self, netinfo: NetworkInfo, verify_shares: bool = True):
+        self.netinfo = netinfo
+        self.verify_shares = verify_shares
+        self.ciphertext: Optional[Ciphertext] = None
+        self.shares: Dict = {}
+        self.pending: Dict = {}  # shares that arrived before the ciphertext
+        self.terminated = False
+        self.plaintext: Optional[bytes] = None
+
+    def set_ciphertext(self, ct: Ciphertext, check: bool = True) -> Step:
+        """Install the ciphertext and contribute our share."""
+        if self.ciphertext is not None:
+            return Step()
+        if check and not ct.verify():
+            raise ValueError("invalid ciphertext")
+        self.ciphertext = ct
+        step = Step()
+        if self.netinfo.sk_share is not None:
+            share = self.netinfo.sk_share.decrypt_share(ct)
+            step.broadcast((MSG_DEC_SHARE, share.to_bytes()))
+            step.extend(self._handle_share(self.netinfo.our_id, share))
+        for sender, share in list(self.pending.items()):
+            step.extend(self._handle_share(sender, share))
+        self.pending.clear()
+        return step
+
+    def handle_message(self, sender, message) -> Step:
+        kind, payload = message[0], message[1]
+        if kind != MSG_DEC_SHARE:
+            return Step().fault(sender, f"threshold_decrypt: unknown {kind!r}")
+        try:
+            share = DecryptionShare.from_bytes(bytes(payload))
+        except ValueError:
+            return Step().fault(sender, "threshold_decrypt: bad share bytes")
+        if self.ciphertext is None:
+            self.pending[sender] = share
+            return Step()
+        return self._handle_share(sender, share)
+
+    def _handle_share(self, sender, share: DecryptionShare) -> Step:
+        if self.terminated or sender in self.shares:
+            return Step()
+        idx = self.netinfo.index(sender)
+        if idx is None:
+            return Step().fault(sender, "threshold_decrypt: not a validator")
+        if self.verify_shares:
+            pk_share = self.netinfo.pk_set.public_key_share(idx)
+            if not pk_share.verify_decryption_share(share, self.ciphertext):
+                return Step().fault(sender, "threshold_decrypt: invalid share")
+        self.shares[sender] = share
+        return self._try_decrypt()
+
+    def _try_decrypt(self) -> Step:
+        t = self.netinfo.pk_set.threshold
+        if self.terminated or len(self.shares) <= t:
+            return Step()
+        plaintext = self.netinfo.pk_set.decrypt(
+            {self.netinfo.index(nid): s for nid, s in self.shares.items()},
+            self.ciphertext,
+        )
+        self.terminated = True
+        self.plaintext = plaintext
+        step = Step()
+        step.output.append(plaintext)
+        return step

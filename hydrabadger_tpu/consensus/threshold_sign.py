@@ -1,0 +1,105 @@
+"""ThresholdSign: collaborative BLS signature — the common coin.
+
+hbbft's `threshold_sign` equivalent (reached through BinaryAgreement's
+coin; SURVEY.md §2.2 row 2).  Each validator contributes a signature
+share over an agreed document; any t+1 verified shares combine into the
+unique master signature, whose hash parity is an unpredictable common
+coin.  Share verification is pairing-heavy — exactly the work the TPU
+engine batches across instances (BASELINE.json north star).
+"""
+from __future__ import annotations
+
+from typing import Dict, Hashable, Optional, TypeVar
+
+from ..crypto.threshold import Signature, SignatureShare
+from .types import NetworkInfo, Step
+
+N = TypeVar("N", bound=Hashable)
+
+MSG_SHARE = "ts_share"
+
+
+class ThresholdSign:
+    def __init__(self, netinfo: NetworkInfo, doc: bytes, verify_shares: bool = True):
+        self.netinfo = netinfo
+        self.doc = bytes(doc)
+        self.verify_shares = verify_shares
+        self.shares: Dict = {}  # node -> SignatureShare
+        self.had_input = False
+        self.terminated = False
+        self.signature: Optional[Signature] = None
+
+    def sign(self) -> Step:
+        """Contribute our share (validators only; observers just listen)."""
+        if self.had_input:
+            return Step()
+        self.had_input = True
+        if self.netinfo.sk_share is None:
+            return Step()
+        share = self.netinfo.sk_share.sign_share(self.doc)
+        step = Step().broadcast((MSG_SHARE, share.to_bytes()))
+        return step.extend(self._handle_share(self.netinfo.our_id, share))
+
+    def handle_message(self, sender, message) -> Step:
+        kind, payload = message[0], message[1]
+        if kind != MSG_SHARE:
+            return Step().fault(sender, f"threshold_sign: unknown {kind!r}")
+        try:
+            share = SignatureShare.from_bytes(bytes(payload))
+        except ValueError:
+            return Step().fault(sender, "threshold_sign: undecodable share")
+        return self._handle_share(sender, share)
+
+    def _handle_share(self, sender, share: SignatureShare) -> Step:
+        if self.terminated or sender in self.shares:
+            return Step()
+        idx = self.netinfo.index(sender)
+        if idx is None:
+            return Step().fault(sender, "threshold_sign: not a validator")
+        if self.verify_shares and not self.netinfo.pk_set.verify_signature_share(
+            idx, share, self.doc
+        ):
+            return Step().fault(sender, "threshold_sign: invalid share")
+        self.shares[sender] = share
+        return self._try_combine()
+
+    def _try_combine(self) -> Step:
+        t = self.netinfo.pk_set.threshold
+        if self.terminated or len(self.shares) <= t:
+            return Step()
+        sig = self.netinfo.pk_set.combine_signatures(
+            {self.netinfo.index(nid): s for nid, s in self.shares.items()}
+        )
+        if self.verify_shares:
+            # shares were individually verified; combination is sound
+            pass
+        elif not self.netinfo.pk_set.public_key().verify(sig, self.doc):
+            # optimistic path failed: a bad share slipped in.  Fall back to
+            # verifying shares individually and flagging the culprit(s).
+            step = Step()
+            good = {}
+            for nid, s in list(self.shares.items()):
+                if self.netinfo.pk_set.verify_signature_share(
+                    self.netinfo.index(nid), s, self.doc
+                ):
+                    good[nid] = s
+                else:
+                    del self.shares[nid]
+                    step.fault(nid, "threshold_sign: invalid share")
+            if len(good) <= t:
+                return step
+            sig = self.netinfo.pk_set.combine_signatures(
+                {self.netinfo.index(nid): s for nid, s in good.items()}
+            )
+            self.terminated = True
+            self.signature = sig
+            step.output.append(sig)
+            return step
+        self.terminated = True
+        self.signature = sig
+        step = Step()
+        step.output.append(sig)
+        return step
+
+    def coin_value(self) -> Optional[bool]:
+        return self.signature.parity() if self.signature else None

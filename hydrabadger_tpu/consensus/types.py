@@ -1,0 +1,140 @@
+"""Shared types for the pure sans-io consensus cores.
+
+Every protocol exposes methods returning a `Step` — the contract mirrored
+from hbbft's `CpStep` that the reference's handler consumes
+(/root/reference/src/hydrabadger/handler.rs:677-769, lib.rs:183): a batch
+of outbound `TargetedMessage`s, any protocol `output`, and a `fault_log`
+of observed misbehaviour.  Cores never touch sockets, clocks or ambient
+randomness; all effects flow through Steps and explicit rng arguments.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, FrozenSet, Generic, Hashable, List, Optional, TypeVar
+
+N = TypeVar("N", bound=Hashable)
+
+
+@dataclass(frozen=True)
+class Target(Generic[N]):
+    """Message routing target: all peers, all-except, or an explicit set."""
+
+    kind: str  # "all" | "all_except" | "nodes"
+    nodes: FrozenSet[N] = frozenset()
+
+    @classmethod
+    def all(cls) -> "Target":
+        return cls("all")
+
+    @classmethod
+    def all_except(cls, nodes) -> "Target":
+        return cls("all_except", frozenset(nodes))
+
+    @classmethod
+    def node(cls, node) -> "Target":
+        return cls("nodes", frozenset([node]))
+
+    @classmethod
+    def nodes_(cls, nodes) -> "Target":
+        return cls("nodes", frozenset(nodes))
+
+    def includes(self, node: N, all_nodes=None) -> bool:
+        if self.kind == "all":
+            return True
+        if self.kind == "all_except":
+            return node not in self.nodes
+        return node in self.nodes
+
+
+@dataclass(frozen=True)
+class TargetedMessage(Generic[N]):
+    target: Target[N]
+    message: Any
+
+
+@dataclass(frozen=True)
+class Fault(Generic[N]):
+    node_id: N
+    kind: str
+
+
+@dataclass
+class Step(Generic[N]):
+    """The sole output channel of a protocol core."""
+
+    messages: List[TargetedMessage[N]] = field(default_factory=list)
+    output: List[Any] = field(default_factory=list)
+    fault_log: List[Fault[N]] = field(default_factory=list)
+
+    def send(self, target: Target[N], message: Any) -> "Step[N]":
+        self.messages.append(TargetedMessage(target, message))
+        return self
+
+    def broadcast(self, message: Any) -> "Step[N]":
+        return self.send(Target.all(), message)
+
+    def to(self, node: N, message: Any) -> "Step[N]":
+        return self.send(Target.node(node), message)
+
+    def fault(self, node_id: N, kind: str) -> "Step[N]":
+        self.fault_log.append(Fault(node_id, kind))
+        return self
+
+    def extend(self, other: "Step[N]") -> "Step[N]":
+        self.messages.extend(other.messages)
+        self.output.extend(other.output)
+        self.fault_log.extend(other.fault_log)
+        return self
+
+    def map_messages(self, fn) -> "Step[N]":
+        """Wrap each message payload (e.g. tag with an instance id)."""
+        self.messages = [
+            TargetedMessage(tm.target, fn(tm.message)) for tm in self.messages
+        ]
+        return self
+
+    @classmethod
+    def empty(cls) -> "Step[N]":
+        return cls()
+
+
+class NetworkInfo(Generic[N]):
+    """Static per-era network topology + key material.
+
+    The analogue of hbbft's `NetworkInfo` built at
+    /root/reference/src/hydrabadger/state.rs:295: sorted validator list,
+    this node's id and (optional — observers lack one) secret key share,
+    and the era's master `PublicKeySet`.
+    """
+
+    def __init__(self, our_id: N, node_ids, pk_set, sk_share=None):
+        self.our_id = our_id
+        self.node_ids = sorted(node_ids)
+        self.pk_set = pk_set
+        self.sk_share = sk_share
+        self._index = {nid: i for i, nid in enumerate(self.node_ids)}
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_ids)
+
+    @property
+    def num_faulty(self) -> int:
+        return (len(self.node_ids) - 1) // 3
+
+    @property
+    def num_correct(self) -> int:
+        return len(self.node_ids) - self.num_faulty
+
+    def index(self, node_id: N) -> Optional[int]:
+        return self._index.get(node_id)
+
+    def our_index(self) -> Optional[int]:
+        return self._index.get(self.our_id)
+
+    def is_validator(self, node_id: Optional[N] = None) -> bool:
+        nid = self.our_id if node_id is None else node_id
+        return nid in self._index
+
+    def public_key_share(self, node_id: N):
+        return self.pk_set.public_key_share(self._index[node_id])

@@ -1,0 +1,89 @@
+"""Deterministic in-process message router for consensus cores.
+
+The minimal network plane: N protocol instances stepped in lockstep, a
+FIFO queue of (sender, recipient, message), and adversary hooks.  This is
+both the unit-test harness (SURVEY.md §4 plan b) and the substrate the
+benchmark simulator builds on.  Replaces the reference's
+"run 4 OS processes and watch the logs" verification story
+(/root/reference/README.md:12-25) with something seeded and replayable.
+"""
+from __future__ import annotations
+
+import random
+from collections import deque
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple, TypeVar
+
+from ..consensus.types import Step, TargetedMessage
+
+N = TypeVar("N", bound=Hashable)
+
+# adversary: fn(sender, recipient, message) -> list of (recipient, message)
+# deliveries (empty = drop; >1 = duplicate); None = deliver unchanged.
+Adversary = Callable[[Any, Any, Any], Optional[List[Tuple[Any, Any]]]]
+
+
+class Router:
+    """Routes Steps between named protocol instances until quiescence."""
+
+    def __init__(
+        self,
+        node_ids,
+        handle: Callable[[Any, Any, Any], Step],
+        adversary: Optional[Adversary] = None,
+        seed: int = 0,
+        shuffle: bool = False,
+    ):
+        self.node_ids = list(node_ids)
+        self.handle = handle  # (our_id, sender, message) -> Step
+        self.adversary = adversary
+        self.rng = random.Random(seed)
+        self.shuffle = shuffle
+        self.queue: deque = deque()
+        self.outputs: Dict[Any, List[Any]] = {nid: [] for nid in self.node_ids}
+        self.faults: List[Tuple[Any, Any]] = []
+        self.delivered = 0
+
+    def dispatch_step(self, sender, step: Step) -> None:
+        """Queue a step's messages; record its outputs/faults."""
+        self.outputs[sender].extend(step.output)
+        self.faults.extend((sender, f) for f in step.fault_log)
+        for tm in step.messages:
+            for recipient in self.node_ids:
+                if recipient == sender:
+                    continue  # multicasts are self-handled by cores
+                if tm.target.includes(recipient):
+                    self._enqueue(sender, recipient, tm.message)
+
+    def _enqueue(self, sender, recipient, message) -> None:
+        if self.adversary is not None:
+            replacement = self.adversary(sender, recipient, message)
+            if replacement is not None:
+                for rec, msg in replacement:
+                    self.queue.append((sender, rec, msg))
+                return
+        self.queue.append((sender, recipient, message))
+
+    def deliver_one(self) -> bool:
+        if not self.queue:
+            return False
+        if self.shuffle and len(self.queue) > 1:
+            idx = self.rng.randrange(len(self.queue))
+            self.queue.rotate(-idx)
+            item = self.queue.popleft()
+            self.queue.rotate(idx)
+        else:
+            item = self.queue.popleft()
+        sender, recipient, message = item
+        step = self.handle(recipient, sender, message)
+        self.delivered += 1
+        if step is not None:
+            self.dispatch_step(recipient, step)
+        return True
+
+    def run(self, max_messages: int = 1_000_000) -> int:
+        count = 0
+        while self.deliver_one():
+            count += 1
+            if count > max_messages:
+                raise RuntimeError("router did not quiesce (livelock?)")
+        return count

@@ -1,0 +1,99 @@
+"""Binary Agreement tests (hash coin for determinism; threshold coin e2e)."""
+import random
+
+import pytest
+
+from hydrabadger_tpu.consensus.binary_agreement import BinaryAgreement
+from hydrabadger_tpu.consensus.types import NetworkInfo
+from hydrabadger_tpu.crypto import threshold as th
+from hydrabadger_tpu.sim.router import Router
+
+
+def run_aba(n, inputs, coin_mode="hash", netinfos=None, seed=0, shuffle=False,
+            adversary=None):
+    ids = [f"n{i}" for i in range(n)]
+    if netinfos is None:
+        netinfos = {i: NetworkInfo(i, ids, pk_set=None) for i in ids}
+    instances = {
+        i: BinaryAgreement(netinfos[i], b"sid", coin_mode=coin_mode)
+        for i in ids
+    }
+    router = Router(
+        ids,
+        lambda me, sender, msg: instances[me].handle_message(sender, msg),
+        seed=seed,
+        shuffle=shuffle,
+        adversary=adversary,
+    )
+    for i, v in zip(ids, inputs):
+        router.dispatch_step(i, instances[i].propose(v))
+    router.run()
+    return router, instances
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 7])
+@pytest.mark.parametrize("value", [False, True])
+def test_unanimous_input_decides_that_value(n, value):
+    router, _ = run_aba(n, [value] * n)
+    for nid, outs in router.outputs.items():
+        assert outs == [value], f"{nid}: {outs}"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_mixed_inputs_agree(seed):
+    n = 4
+    rng = random.Random(seed)
+    inputs = [rng.random() < 0.5 for _ in range(n)]
+    router, _ = run_aba(n, inputs, seed=seed, shuffle=True)
+    decisions = [tuple(router.outputs[f"n{i}"]) for i in range(n)]
+    assert all(len(d) == 1 for d in decisions), decisions
+    assert len(set(decisions)) == 1, f"disagreement: {decisions}"
+    # validity: decision was someone's input
+    assert decisions[0][0] in inputs
+
+
+def test_agreement_under_message_duplication():
+    def adversary(sender, recipient, message):
+        return [(recipient, message), (recipient, message)]  # duplicate all
+
+    router, _ = run_aba(4, [True, False, True, False], adversary=adversary)
+    decisions = {tuple(v) for v in router.outputs.values()}
+    assert len(decisions) == 1 and len(next(iter(decisions))) == 1
+
+
+def test_threshold_coin_end_to_end():
+    """Real BLS common coin with n=4, t=1."""
+    n = 4
+    rng = random.Random(11)
+    ids = [f"n{i}" for i in range(n)]
+    sks = th.SecretKeySet.random(1, rng)
+    pk_set = sks.public_keys()
+    netinfos = {
+        nid: NetworkInfo(nid, ids, pk_set, sks.secret_key_share(i))
+        for i, nid in enumerate(ids)
+    }
+    router, instances = run_aba(
+        n, [True, False, False, True], coin_mode="threshold", netinfos=netinfos
+    )
+    decisions = [tuple(router.outputs[i]) for i in ids]
+    assert all(len(d) == 1 for d in decisions), decisions
+    assert len(set(decisions)) == 1
+
+
+def test_term_shortcut_rescues_late_node():
+    """A node that missed whole rounds decides via f+1 Term messages."""
+    n = 4
+    victim = "n3"
+    dropped = []
+
+    def adversary(sender, recipient, message):
+        # victim misses everything except term messages
+        if recipient == victim and message[2][0] != "term":
+            dropped.append(message)
+            return []
+        return None
+
+    router, instances = run_aba(
+        n, [True, True, True, False], adversary=adversary
+    )
+    assert router.outputs[victim] and router.outputs[victim][0] == router.outputs["n0"][0]

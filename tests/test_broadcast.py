@@ -1,0 +1,105 @@
+"""Reliable Broadcast protocol tests over the deterministic router."""
+import pytest
+
+from hydrabadger_tpu.consensus.broadcast import Broadcast
+from hydrabadger_tpu.consensus.types import NetworkInfo
+from hydrabadger_tpu.sim.router import Router
+
+
+def make_net(n):
+    ids = [f"n{i}" for i in range(n)]
+    return ids, {i: NetworkInfo(i, ids, pk_set=None) for i in ids}
+
+
+def run_broadcast(n, payload, adversary=None, seed=0, shuffle=False):
+    ids, nets = make_net(n)
+    proposer = ids[0]
+    instances = {i: Broadcast(nets[i], proposer) for i in ids}
+    router = Router(
+        ids,
+        lambda me, sender, msg: instances[me].handle_message(sender, msg),
+        adversary=adversary,
+        seed=seed,
+        shuffle=shuffle,
+    )
+    router.dispatch_step(proposer, instances[proposer].broadcast(payload))
+    router.run()
+    return router
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 7])
+def test_all_nodes_decide_proposer_value(n):
+    payload = b"broadcast payload \xff\x00" * 5
+    router = run_broadcast(n, payload)
+    for nid, outs in router.outputs.items():
+        assert outs == [payload], f"{nid} got {outs!r}"
+
+
+def test_shuffled_delivery_still_decides():
+    payload = b"shuffle me"
+    for seed in range(5):
+        router = run_broadcast(7, payload, seed=seed, shuffle=True)
+        assert all(o == [payload] for o in router.outputs.values())
+
+
+def test_tolerates_f_crashed_receivers():
+    """With f nodes silent, the rest still decide."""
+    n = 7  # f = 2
+    ids, nets = make_net(n)
+    dead = set(ids[-2:])
+    proposer = ids[0]
+    instances = {i: Broadcast(nets[i], proposer) for i in ids}
+
+    def handle(me, sender, msg):
+        if me in dead:
+            return None
+        return instances[me].handle_message(sender, msg)
+
+    router = Router(ids, handle)
+    router.dispatch_step(proposer, instances[proposer].broadcast(b"x" * 100))
+    router.run()
+    for nid in ids:
+        if nid not in dead:
+            assert router.outputs[nid] == [b"x" * 100]
+
+
+def test_dropped_echoes_to_one_node_recovers_via_readys():
+    """A node that misses many echoes still decodes from the rest."""
+    n = 4
+    victim = "n3"
+
+    def adversary(sender, recipient, message):
+        if recipient == victim and message[0] == "bc_echo" and sender in ("n1",):
+            return []  # drop
+        return None
+
+    router = run_broadcast(n, b"resilient", adversary=adversary)
+    assert router.outputs[victim] == [b"resilient"]
+
+
+def test_non_proposer_value_flagged():
+    ids, nets = make_net(4)
+    inst = Broadcast(nets["n1"], "n0")
+    from hydrabadger_tpu.consensus.merkle import MerkleTree
+
+    tree = MerkleTree([b"a", b"b", b"c", b"d"])
+    step = inst.handle_message("n2", ("bc_value", tree.proof(1).wire()))
+    assert step.fault_log and step.fault_log[0].node_id == "n2"
+
+
+def test_corrupt_proof_flagged():
+    ids, nets = make_net(4)
+    inst = Broadcast(nets["n1"], "n0")
+    from hydrabadger_tpu.consensus.merkle import MerkleTree
+
+    tree = MerkleTree([b"a", b"b", b"c", b"d"])
+    proof = tree.proof(1)
+    bad = (b"tampered", proof.index, tuple(proof.path), proof.root)
+    step = inst.handle_message("n0", ("bc_value", bad))
+    assert step.fault_log
+
+
+def test_large_payload():
+    payload = bytes(range(256)) * 200  # 51 KB
+    router = run_broadcast(7, payload)
+    assert all(o == [payload] for o in router.outputs.values())

@@ -1,0 +1,84 @@
+"""Subset (ACS) protocol tests."""
+import random
+
+import pytest
+
+from hydrabadger_tpu.consensus.subset import Subset
+from hydrabadger_tpu.consensus.types import NetworkInfo
+from hydrabadger_tpu.crypto import threshold as th
+from hydrabadger_tpu.sim.router import Router
+
+
+def run_subset(n, proposals, coin_mode="hash", seed=0, shuffle=False,
+               silent=frozenset(), netinfos=None):
+    ids = [f"n{i}" for i in range(n)]
+    if netinfos is None:
+        netinfos = {i: NetworkInfo(i, ids, pk_set=None) for i in ids}
+    instances = {
+        i: Subset(netinfos[i], b"epoch0", coin_mode=coin_mode) for i in ids
+    }
+    router = Router(
+        ids,
+        lambda me, sender, msg: instances[me].handle_message(sender, msg),
+        seed=seed,
+        shuffle=shuffle,
+    )
+    for i in ids:
+        if i not in silent:
+            router.dispatch_step(i, instances[i].propose(proposals[i]))
+    router.run()
+    return router, instances
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_all_proposals_accepted_when_synchronous(n):
+    ids = [f"n{i}" for i in range(n)]
+    proposals = {i: f"payload-{i}".encode() for i in ids}
+    router, instances = run_subset(n, proposals)
+    results = [tuple(sorted(router.outputs[i][0].items())) for i in ids]
+    assert all(len(router.outputs[i]) == 1 for i in ids)
+    assert len(set(results)) == 1, "all nodes agree on the subset"
+    # synchronous delivery: every proposal accepted
+    assert dict(results[0]) == proposals
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_agreement_under_shuffling(seed):
+    n = 4
+    ids = [f"n{i}" for i in range(n)]
+    proposals = {i: f"p{i}".encode() * 20 for i in ids}
+    router, _ = run_subset(n, proposals, seed=seed, shuffle=True)
+    results = [tuple(sorted(router.outputs[i][0].items())) for i in ids]
+    assert len(set(results)) == 1
+    # at least N - f proposals make it in
+    assert len(results[0]) >= 3
+
+
+def test_silent_proposer_excluded_but_subset_completes():
+    n = 4
+    ids = [f"n{i}" for i in range(n)]
+    proposals = {i: f"p{i}".encode() for i in ids}
+    router, _ = run_subset(n, proposals, silent=frozenset(["n2"]))
+    results = [dict(router.outputs[i][0]) for i in ids]
+    assert all(r == results[0] for r in results)
+    assert "n2" not in results[0]
+    assert len(results[0]) >= 3
+
+
+def test_subset_with_threshold_coin():
+    n = 4
+    rng = random.Random(3)
+    ids = [f"n{i}" for i in range(n)]
+    sks = th.SecretKeySet.random(1, rng)
+    pk_set = sks.public_keys()
+    netinfos = {
+        nid: NetworkInfo(nid, ids, pk_set, sks.secret_key_share(i))
+        for i, nid in enumerate(ids)
+    }
+    proposals = {i: f"tc-{i}".encode() for i in ids}
+    router, _ = run_subset(
+        n, proposals, coin_mode="threshold", netinfos=netinfos
+    )
+    results = [tuple(sorted(router.outputs[i][0].items())) for i in ids]
+    assert len(set(results)) == 1
+    assert len(results[0]) >= 3

@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Hashable, Optional, Set, TypeVar
 
 from .threshold_sign import ThresholdSign
-from .types import NetworkInfo, Step
+from .types import NetworkInfo, Step, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -78,6 +78,7 @@ class BinaryAgreement:
         self.estimate = bool(value)
         return self._send_bval(self.round, bool(value))
 
+    @guarded_handler("ba")
     def handle_message(self, sender, message) -> Step:
         if self.terminated:
             return Step()

@@ -17,7 +17,7 @@ from typing import Dict, Hashable, Optional, TypeVar
 
 from ..crypto.rs import ReedSolomon
 from .merkle import MerkleTree, Proof
-from .types import NetworkInfo, Step, Target
+from .types import NetworkInfo, Step, Target, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -68,6 +68,7 @@ class Broadcast:
             step.extend(self._send_echo(my_proof))
         return step
 
+    @guarded_handler("broadcast")
     def handle_message(self, sender, message) -> Step:
         kind, payload = message[0], message[1]
         if kind == MSG_VALUE:

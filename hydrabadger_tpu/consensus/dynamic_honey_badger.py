@@ -35,11 +35,20 @@ from ..crypto.dkg import Ack, Part, SyncKeyGen
 from ..crypto.threshold import PublicKey, PublicKeySet, SecretKey, SecretKeyShare
 from ..utils import codec
 from .honey_badger import Batch, HoneyBadger
-from .types import NetworkInfo, Step
+from .types import NetworkInfo, Step, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
 MSG = "dhb"
+
+
+def _freeze(value):
+    """Hashable canonical form of nested tuples/bytes for dedup matching."""
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return bytes(value)
+    return value
 
 
 # -- changes ----------------------------------------------------------------
@@ -102,12 +111,6 @@ class _KeyGenState:
     new_ids: list
     new_pub_keys: dict
     key_gen: SyncKeyGen
-    our_part_queued: bool = False
-    parts_seen: set = None
-
-    def __post_init__(self):
-        if self.parts_seen is None:
-            self.parts_seen = set()
 
 
 class DynamicHoneyBadger:
@@ -139,9 +142,10 @@ class DynamicHoneyBadger:
         self.hb = self._make_hb()
         self.votes: Dict = {}  # voter -> change (latest committed vote)
         self.our_vote: Optional[tuple] = None
-        self.vote_queued = False
         self.key_gen: Optional[_KeyGenState] = None
-        self.out_kg: List[tuple] = []  # queued keygen msgs for next contribution
+        # keygen msgs ship with every contribution until seen committed —
+        # an ACS slot may legitimately decide 0, dropping that proposal
+        self.pending_kg: List[tuple] = []
         self.batches: List[DhbBatch] = []
         # messages for eras we haven't reached yet (rushed peers); replayed
         # after each era switch so their era-start proposals aren't lost
@@ -201,9 +205,8 @@ class DynamicHoneyBadger:
         return self.netinfo.is_validator() and self.netinfo.sk_share is not None
 
     def vote_for(self, change: tuple) -> Step:
-        """Queue our signed vote; it ships with the next contribution."""
+        """Set our vote; it ships with every contribution until committed."""
         self.our_vote = tuple(change)
-        self.vote_queued = True
         return Step()
 
     def vote_to_add(self, node_id, pub_key: PublicKey) -> Step:
@@ -216,18 +219,18 @@ class DynamicHoneyBadger:
         if not self.is_validator:
             return Step()
         votes = []
-        if self.vote_queued and self.our_vote is not None:
+        # re-send until our vote shows up in the committed tally: a slot
+        # that decides 0 silently drops its contribution
+        if self.our_vote is not None and self.votes.get(self.our_id) != self.our_vote:
             sig = self.our_sk.sign(self._vote_doc(self.our_vote))
             votes.append((self.our_id, self.our_vote, sig.to_bytes()))
-            self.vote_queued = False
-        kg_msgs = self.out_kg
-        self.out_kg = []
         internal = codec.encode(
-            (bytes(contribution), tuple(votes), tuple(kg_msgs))
+            (bytes(contribution), tuple(votes), tuple(self.pending_kg))
         )
         step = self.hb.propose(internal, rng)
         return self._filter(step)
 
+    @guarded_handler("dhb")
     def handle_message(self, sender, message) -> Step:
         _tag, era, inner = message[0], int(message[1]), message[2]
         if era > self.era:
@@ -295,6 +298,12 @@ class DynamicHoneyBadger:
             for vote in votes:
                 self._commit_vote(proposer, vote, step)
             for kg in kg_msgs:
+                if proposer == self.our_id:
+                    # our own keygen msg committed: stop retransmitting it
+                    kg_t = _freeze(kg)
+                    self.pending_kg = [
+                        m for m in self.pending_kg if _freeze(m) != kg_t
+                    ]
                 self._commit_keygen_msg(proposer, kg, step)
         self.epoch = self.era + hb_batch.epoch + 1
         change = None
@@ -395,10 +404,9 @@ class DynamicHoneyBadger:
             self.key_gen = state
             if self.is_validator:
                 part = kg.propose()
-                self.out_kg.append(
+                self.pending_kg.append(
                     ("part", part.commit_bytes, tuple(part.enc_rows))
                 )
-                state.our_part_queued = True
         else:
             # we are being removed: follow the transcript without a DKG role
             self.key_gen = _KeyGenState(
@@ -419,7 +427,7 @@ class DynamicHoneyBadger:
                 if not outcome.valid:
                     step.fault(proposer, f"dhb keygen: {outcome.fault}")
                 elif outcome.ack is not None and self.is_validator:
-                    self.out_kg.append(
+                    self.pending_kg.append(
                         (
                             "ack",
                             outcome.ack.proposer_idx,
@@ -453,8 +461,9 @@ class DynamicHoneyBadger:
         self.hb = self._make_hb()
         self.votes = {}
         self.key_gen = None
-        self.out_kg = []
-        self.vote_queued = False
+        self.pending_kg = []
+        if self.our_vote == state.change:
+            self.our_vote = None  # our change just completed
         self._just_switched = True
 
 

@@ -18,7 +18,7 @@ from typing import Dict, Hashable, List, Optional, TypeVar
 from ..crypto.threshold import Ciphertext
 from .subset import Subset
 from .threshold_decrypt import ThresholdDecrypt
-from .types import NetworkInfo, Step
+from .types import NetworkInfo, Step, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -64,6 +64,9 @@ class HoneyBadger:
         self.epoch = start_epoch
         self.epochs: Dict[int, _EpochState] = {}
         self.has_input: Dict[int, bool] = {}
+        # messages beyond the pipelining window (a laggard's view of far-ahead
+        # peers); buffered, not dropped — they are never resent
+        self.deferred: List[tuple] = []
 
     # -- API ----------------------------------------------------------------
 
@@ -87,12 +90,15 @@ class HoneyBadger:
         step.extend(self._progress(epoch))
         return step
 
+    @guarded_handler("hb")
     def handle_message(self, sender, message) -> Step:
         _tag, epoch, inner = message[0], int(message[1]), message[2]
         if epoch < self.epoch:
             return Step()  # stale epoch; already concluded
         if epoch > self.epoch + MAX_FUTURE_EPOCHS:
-            return Step().fault(sender, "hb: epoch too far in the future")
+            if len(self.deferred) < 100_000:
+                self.deferred.append((epoch, sender, message))
+            return Step()
         state = self._epoch_state(epoch)
         step = Step()
         if inner[0] == "cs":
@@ -188,6 +194,14 @@ class HoneyBadger:
                 if epoch == self.epoch:
                     self.epoch = epoch + 1
                     self.epochs.pop(epoch, None)
+                    # replay messages that were beyond the window
+                    if self.deferred:
+                        pending, self.deferred = self.deferred, []
+                        for ep, sender, msg in pending:
+                            if ep <= self.epoch + MAX_FUTURE_EPOCHS:
+                                step.extend(self.handle_message(sender, msg))
+                            else:
+                                self.deferred.append((ep, sender, msg))
                     # the next epoch may already be satisfied by buffered
                     # messages; drive it now or it would stall quiescent
                     step.extend(self._progress(self.epoch))

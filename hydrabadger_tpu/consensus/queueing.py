@@ -27,9 +27,13 @@ class QueueingHoneyBadger:
         encrypt: bool = True,
         coin_mode: str = "threshold",
         verify_shares: bool = True,
+        rng=None,
+        auto_propose: bool = True,
     ):
         self.netinfo = netinfo
         self.batch_size = max(1, batch_size)
+        self.rng = rng
+        self.auto_propose = auto_propose
         self.queue: "OrderedDict[bytes, None]" = OrderedDict()
         self.hb = HoneyBadger(
             netinfo,
@@ -45,6 +49,7 @@ class QueueingHoneyBadger:
     def push_transaction(self, txn: bytes, rng=None) -> Step:
         """Queue a transaction; kicks off an epoch if none is in flight."""
         self.queue[bytes(txn)] = None
+        rng = rng or self.rng
         if rng is not None:
             return self._maybe_propose(rng)
         return Step()
@@ -79,8 +84,8 @@ class QueueingHoneyBadger:
             return Step()
         return self._filter(self._propose(rng))
 
-    def _filter(self, step: Step) -> Step:
-        """Decode committed contributions, prune the queue, re-emit batches."""
+    def _decode_batches(self, step: Step) -> list:
+        """Decode committed contributions in-place; prune the queue."""
         out = []
         for item in step.output:
             if not isinstance(item, Batch):
@@ -98,6 +103,25 @@ class QueueingHoneyBadger:
             self.batches.append(batch)
             out.append(batch)
         step.output = out
+        return out
+
+    def _filter(self, step: Step) -> Step:
+        committed = self._decode_batches(step)
+        # a committed batch opens the next epoch: keep the pipeline moving
+        # while transactions remain queued (hbbft re-proposes on output);
+        # iterative so instantly-committing topologies (n=1) don't recurse
+        while (
+            committed
+            and self.auto_propose
+            and self.rng is not None
+            and self.queue
+            and not self.hb.has_input.get(self.hb.epoch)
+        ):
+            sub = self._propose(self.rng)
+            committed = self._decode_batches(sub)
+            step.messages.extend(sub.messages)
+            step.fault_log.extend(sub.fault_log)
+            step.output.extend(sub.output)
         return step
 
     @property

@@ -17,7 +17,7 @@ from typing import Dict, Hashable, Optional, TypeVar
 
 from .binary_agreement import BinaryAgreement
 from .broadcast import Broadcast
-from .types import NetworkInfo, Step
+from .types import NetworkInfo, Step, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -64,6 +64,7 @@ class Subset:
         step.output.clear()
         return Step().extend(step).extend(self._progress())
 
+    @guarded_handler("subset")
     def handle_message(self, sender, message) -> Step:
         _tag, pidx, inner = message[0], int(message[1]), message[2]
         if not 0 <= pidx < self.netinfo.num_nodes:

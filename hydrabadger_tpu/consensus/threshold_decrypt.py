@@ -10,7 +10,7 @@ from __future__ import annotations
 from typing import Dict, Hashable, Optional, TypeVar
 
 from ..crypto.threshold import Ciphertext, DecryptionShare
-from .types import NetworkInfo, Step
+from .types import NetworkInfo, Step, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -44,6 +44,7 @@ class ThresholdDecrypt:
         self.pending.clear()
         return step
 
+    @guarded_handler("threshold_decrypt")
     def handle_message(self, sender, message) -> Step:
         kind, payload = message[0], message[1]
         if kind != MSG_DEC_SHARE:

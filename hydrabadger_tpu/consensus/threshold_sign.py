@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Dict, Hashable, Optional, TypeVar
 
 from ..crypto.threshold import Signature, SignatureShare
-from .types import NetworkInfo, Step
+from .types import NetworkInfo, Step, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -40,6 +40,7 @@ class ThresholdSign:
         step = Step().broadcast((MSG_SHARE, share.to_bytes()))
         return step.extend(self._handle_share(self.netinfo.our_id, share))
 
+    @guarded_handler("threshold_sign")
     def handle_message(self, sender, message) -> Step:
         kind, payload = message[0], message[1]
         if kind != MSG_SHARE:

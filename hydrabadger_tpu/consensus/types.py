@@ -38,7 +38,7 @@ class Target(Generic[N]):
     def nodes_(cls, nodes) -> "Target":
         return cls("nodes", frozenset(nodes))
 
-    def includes(self, node: N, all_nodes=None) -> bool:
+    def includes(self, node: N) -> bool:
         if self.kind == "all":
             return True
         if self.kind == "all_except":
@@ -96,6 +96,29 @@ class Step(Generic[N]):
     @classmethod
     def empty(cls) -> "Step[N]":
         return cls()
+
+
+def guarded_handler(protocol: str):
+    """Decorator for `handle_message(self, sender, message)`: a malformed
+    message from a Byzantine peer must yield a fault entry, never an
+    exception escaping the core (one bad frame must not crash a node).
+    The exception text is preserved in the fault kind for diagnosis.
+    """
+
+    def deco(fn):
+        def wrapper(self, sender, message):
+            try:
+                return fn(self, sender, message)
+            except (ValueError, TypeError, AttributeError, IndexError, KeyError) as e:
+                return Step().fault(
+                    sender, f"{protocol}: malformed message ({type(e).__name__}: {e})"
+                )
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        return wrapper
+
+    return deco
 
 
 class NetworkInfo(Generic[N]):

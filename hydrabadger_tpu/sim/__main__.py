@@ -1,0 +1,68 @@
+"""CLI for the in-process simulator — the north star's `sim` binary.
+
+    python -m hydrabadger_tpu.sim --nodes 16 --epochs 10
+    python -m hydrabadger_tpu.sim --nodes 4 --encrypt --coin threshold --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .network import SimConfig, SimNetwork, drop_adversary, duplicate_adversary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="hydrabadger_tpu in-process simulator")
+    p.add_argument("--nodes", type=int, default=16)
+    p.add_argument("--protocol", choices=["qhb", "dhb"], default="qhb")
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--txns", type=int, default=5, help="txns per node per epoch")
+    p.add_argument("--txn-bytes", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--encrypt", action="store_true", help="threshold-encrypt contributions")
+    p.add_argument("--coin", choices=["hash", "threshold"], default="hash")
+    p.add_argument("--verify", action="store_true", help="verify crypto shares")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--drop", type=float, default=0.0, help="message drop rate")
+    p.add_argument("--dup", type=float, default=0.0, help="message duplication rate")
+    p.add_argument("--json", action="store_true", help="emit metrics as JSON")
+    args = p.parse_args(argv)
+    if args.nodes < 1:
+        p.error("--nodes must be >= 1")
+    if args.epochs < 1:
+        p.error("--epochs must be >= 1")
+    if not 0.0 <= args.drop <= 1.0 or not 0.0 <= args.dup <= 1.0:
+        p.error("--drop/--dup must be in [0, 1]")
+
+    adversary = None
+    if args.drop > 0:
+        adversary = drop_adversary(args.drop, args.seed)
+    elif args.dup > 0:
+        adversary = duplicate_adversary(args.dup, args.seed)
+
+    cfg = SimConfig(
+        n_nodes=args.nodes,
+        protocol=args.protocol,
+        epochs=args.epochs,
+        txns_per_node_per_epoch=args.txns,
+        txn_bytes=args.txn_bytes,
+        batch_size=args.batch_size,
+        encrypt=args.encrypt,
+        coin_mode=args.coin,
+        verify_shares=args.verify,
+        seed=args.seed,
+        adversary=adversary,
+    )
+    net = SimNetwork(cfg)
+    metrics = net.run()
+    if args.json:
+        print(json.dumps(metrics.as_dict()))
+    else:
+        for k, v in metrics.as_dict().items():
+            print(f"{k:>20}: {v}")
+    return 0 if metrics.agreement_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

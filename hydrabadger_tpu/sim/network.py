@@ -1,0 +1,245 @@
+"""In-process multi-node HoneyBadger simulator — test bed + benchmark rig.
+
+The `sim` binary of BASELINE.json's north star: N QueueingHoneyBadger (or
+DynamicHoneyBadger) nodes over the deterministic router, with a seeded
+transaction workload and first-class metrics (epochs/sec, msgs/epoch,
+batch latency) — the observability the reference lacks entirely
+(SURVEY.md §4: its verification story is "watch the logs").
+
+Crypto tiers let the same topology run as pure protocol logic
+(`encrypt=False, coin='hash'`), with real threshold encryption, or with
+full share verification — the CPU baselines the TPU engine is measured
+against.
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..consensus.dynamic_honey_badger import DynamicHoneyBadger
+from ..consensus.queueing import QueueingHoneyBadger
+from ..consensus.types import NetworkInfo
+from ..crypto import threshold as th
+from .router import Router
+
+
+@dataclass
+class SimConfig:
+    n_nodes: int = 16
+    protocol: str = "qhb"  # "qhb" | "dhb"
+    epochs: int = 10
+    # workload (reference defaults: 5 txns x 2 bytes per interval,
+    # hydrabadger.rs:36-40)
+    txns_per_node_per_epoch: int = 5
+    txn_bytes: int = 2
+    batch_size: int = 100
+    # crypto tier
+    encrypt: bool = False
+    coin_mode: str = "hash"  # "hash" | "threshold"
+    verify_shares: bool = False
+    # scheduling
+    seed: int = 0
+    shuffle: bool = True
+    adversary: Optional[Callable] = None
+
+
+@dataclass
+class SimMetrics:
+    epochs_done: int = 0
+    wall_s: float = 0.0
+    messages_delivered: int = 0
+    txns_committed: int = 0
+    bytes_committed: int = 0
+    agreement_ok: bool = True
+    faults: int = 0
+
+    @property
+    def epochs_per_sec(self) -> float:
+        return self.epochs_done / self.wall_s if self.wall_s else 0.0
+
+    @property
+    def msgs_per_epoch(self) -> float:
+        return (
+            self.messages_delivered / self.epochs_done if self.epochs_done else 0.0
+        )
+
+    @property
+    def txns_per_sec(self) -> float:
+        return self.txns_committed / self.wall_s if self.wall_s else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "epochs_done": self.epochs_done,
+            "wall_s": round(self.wall_s, 4),
+            "epochs_per_sec": round(self.epochs_per_sec, 3),
+            "messages_delivered": self.messages_delivered,
+            "msgs_per_epoch": round(self.msgs_per_epoch, 1),
+            "txns_committed": self.txns_committed,
+            "txns_per_sec": round(self.txns_per_sec, 1),
+            "bytes_committed": self.bytes_committed,
+            "agreement_ok": self.agreement_ok,
+            "faults": self.faults,
+        }
+
+
+def trusted_setup(n: int, seed: int):
+    """Dealer-based keys for simulation (the trustless path is crypto.dkg)."""
+    rng = random.Random(seed)
+    ids = [f"n{i:03d}" for i in range(n)]
+    t = (n - 1) // 3
+    sks = th.SecretKeySet.random(t, rng)
+    pk_set = sks.public_keys()
+    netinfos = {
+        nid: NetworkInfo(nid, ids, pk_set, sks.secret_key_share(i))
+        for i, nid in enumerate(ids)
+    }
+    id_sks = {nid: th.SecretKey.random(rng) for nid in ids}
+    return ids, netinfos, id_sks
+
+
+class SimNetwork:
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self.ids, self.netinfos, self.id_sks = trusted_setup(
+            cfg.n_nodes, cfg.seed
+        )
+        self.rng = random.Random(cfg.seed + 1)
+        if cfg.protocol == "qhb":
+            self.nodes: Dict = {
+                nid: QueueingHoneyBadger(
+                    self.netinfos[nid],
+                    batch_size=cfg.batch_size,
+                    encrypt=cfg.encrypt,
+                    coin_mode=cfg.coin_mode,
+                    verify_shares=cfg.verify_shares,
+                )
+                for nid in self.ids
+            }
+        elif cfg.protocol == "dhb":
+            pub_keys = {
+                nid: self.id_sks[nid].public_key() for nid in self.ids
+            }
+            self.nodes = {
+                nid: DynamicHoneyBadger(
+                    nid,
+                    self.id_sks[nid],
+                    self.netinfos[nid],
+                    pub_keys,
+                    encrypt=cfg.encrypt,
+                    coin_mode=cfg.coin_mode,
+                    verify_shares=cfg.verify_shares,
+                    # per-node seed: DKG secrets must differ across nodes
+                    rng=random.Random(cfg.seed * 1_000_003 + 2 + idx),
+                )
+                for idx, nid in enumerate(self.ids)
+            }
+        else:
+            raise ValueError(f"unknown protocol {cfg.protocol!r}")
+        self.router = Router(
+            self.ids,
+            self._handle,
+            adversary=cfg.adversary,
+            seed=cfg.seed + 3,
+            shuffle=cfg.shuffle,
+        )
+        self._txn_counter = 0
+
+    def _handle(self, me, sender, message):
+        return self.nodes[me].handle_message(sender, message)
+
+    def _gen_txn(self) -> bytes:
+        self._txn_counter += 1
+        prefix = self._txn_counter.to_bytes(4, "big")
+        pad = max(0, self.cfg.txn_bytes - 4)
+        return prefix + bytes(self.rng.getrandbits(8) for _ in range(pad))
+
+    def run_epoch(self) -> None:
+        """Generate workload, propose everywhere, run to quiescence."""
+        cfg = self.cfg
+        if cfg.protocol == "qhb":
+            for nid in self.ids:
+                for _ in range(cfg.txns_per_node_per_epoch):
+                    self.nodes[nid].push_transaction(self._gen_txn())
+            for nid in self.ids:
+                self.router.dispatch_step(
+                    nid, self.nodes[nid].force_propose(self.rng)
+                )
+        else:
+            for nid in self.ids:
+                node = self.nodes[nid]
+                if node.is_validator:
+                    payload = b"".join(
+                        self._gen_txn()
+                        for _ in range(cfg.txns_per_node_per_epoch)
+                    )
+                    self.router.dispatch_step(
+                        nid, node.propose(payload, self.rng)
+                    )
+        self.router.run()
+
+    def run(self, epochs: Optional[int] = None) -> SimMetrics:
+        epochs = self.cfg.epochs if epochs is None else epochs
+        m = SimMetrics()
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            self.run_epoch()
+        m.wall_s = time.perf_counter() - t0
+        m.messages_delivered = self.router.delivered
+        m.faults = len(self.router.faults)
+        m.epochs_done = min(len(self._batches(nid)) for nid in self.ids)
+        m.agreement_ok = self._check_agreement()
+        for batch in self._batches(self.ids[0]):
+            for _, txns in sorted(batch.contributions.items()):
+                if isinstance(txns, (list, tuple)):
+                    m.txns_committed += len(txns)
+                    m.bytes_committed += sum(len(t) for t in txns)
+                else:
+                    m.bytes_committed += len(txns)
+        return m
+
+    def _batches(self, nid) -> List:
+        return self.nodes[nid].batches
+
+    def _check_agreement(self) -> bool:
+        def key(batch):
+            items = []
+            for p, v in sorted(batch.contributions.items()):
+                if isinstance(v, (list, tuple)):
+                    items.append((p, tuple(bytes(x) for x in v)))
+                else:
+                    items.append((p, bytes(v)))
+            return tuple(items)
+
+        seqs = {nid: [key(b) for b in self._batches(nid)] for nid in self.ids}
+        shortest = min(len(s) for s in seqs.values())
+        first = seqs[self.ids[0]][:shortest]
+        return all(s[:shortest] == first for s in seqs.values())
+
+
+# -- canned adversaries -----------------------------------------------------
+
+
+def drop_adversary(rate: float, seed: int = 0) -> Callable:
+    """Drop a uniform fraction of messages.  Models lossy channels; HBBFT
+    assumes reliable delivery, so liveness (not safety) may suffer."""
+    rng = random.Random(seed)
+
+    def adv(sender, recipient, message):
+        if rng.random() < rate:
+            return []
+        return None
+
+    return adv
+
+
+def duplicate_adversary(rate: float, seed: int = 0) -> Callable:
+    rng = random.Random(seed)
+
+    def adv(sender, recipient, message):
+        if rng.random() < rate:
+            return [(recipient, message), (recipient, message)]
+        return None
+
+    return adv

@@ -1,0 +1,74 @@
+"""Simulator tests: agreement/totality properties under adversaries."""
+import pytest
+
+from hydrabadger_tpu.sim.network import (
+    SimConfig,
+    SimNetwork,
+    duplicate_adversary,
+    trusted_setup,
+)
+
+
+def test_16_node_sim_baseline_config():
+    """BASELINE.json config 2: 16-node in-process sim, QHB."""
+    cfg = SimConfig(n_nodes=16, epochs=2, seed=7)
+    m = SimNetwork(cfg).run()
+    assert m.epochs_done == 2
+    assert m.agreement_ok
+    assert m.txns_committed == 16 * 5 * 2  # all generated txns commit
+    assert m.faults == 0
+
+
+def test_sim_deterministic_given_seed():
+    runs = []
+    for _ in range(2):
+        cfg = SimConfig(n_nodes=4, epochs=2, seed=3)
+        net = SimNetwork(cfg)
+        m = net.run()
+        runs.append(
+            (
+                m.messages_delivered,
+                tuple(
+                    tuple(sorted((p, tuple(t)) for p, t in b.contributions.items()))
+                    for b in net.nodes[net.ids[0]].batches
+                ),
+            )
+        )
+    assert runs[0] == runs[1]
+
+
+def test_sim_agreement_under_duplication():
+    cfg = SimConfig(
+        n_nodes=4,
+        epochs=2,
+        seed=5,
+        adversary=duplicate_adversary(0.3, 5),
+    )
+    m = SimNetwork(cfg).run()
+    assert m.agreement_ok
+    assert m.epochs_done == 2
+
+
+def test_sim_dhb_protocol():
+    cfg = SimConfig(n_nodes=4, protocol="dhb", epochs=2, seed=9)
+    m = SimNetwork(cfg).run()
+    assert m.agreement_ok
+    assert m.epochs_done == 2
+    assert m.bytes_committed > 0
+
+
+def test_sim_encrypted_tier():
+    cfg = SimConfig(n_nodes=4, epochs=1, seed=11, encrypt=True)
+    m = SimNetwork(cfg).run()
+    assert m.agreement_ok
+    assert m.epochs_done == 1
+    assert m.txns_committed == 4 * 5
+
+
+def test_trusted_setup_shapes():
+    ids, netinfos, id_sks = trusted_setup(7, 0)
+    assert len(ids) == 7
+    ni = netinfos[ids[0]]
+    assert ni.num_faulty == 2
+    assert ni.num_correct == 5
+    assert ni.pk_set.threshold == 2

@@ -1,0 +1,181 @@
+"""GF(2^8) linear algebra on TPU — the Reed-Solomon hot kernel.
+
+The insight that makes erasure coding MXU-shaped (SURVEY.md §2.2 row 4):
+multiplication by a constant in GF(2^8) is *linear over GF(2)*, so an
+[m, k] GF(2^8) matrix lifts to an [8m, 8k] bit matrix and a whole RS
+encode becomes
+
+    bits(out) = (bit_matrix @ bits(data)) mod 2
+
+— an integer matmul the 128x128 systolic array eats, followed by a
+cheap parity mask.  Batching across (instances x proposers) folds into
+the matmul's N dimension, which is exactly how the framework saturates
+a chip with thousands of concurrent Broadcast instances
+(BASELINE.json configs 3-5).
+
+Three implementations, all bit-equal to crypto/gf256.matmul:
+  - `gf_matmul_gather`: log/exp-table gathers + XOR reduce (VPU path,
+    reference semantics, any shape)
+  - `gf_matmul_bits`:   the MXU bit-matmul lowering (the fast path)
+  - `gf_matmul_pallas`: fused expand->matmul->parity->pack Pallas kernel
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import gf256
+
+# tables as device constants
+_EXP = jnp.asarray(gf256.EXP_TABLE, dtype=jnp.int32)  # [512]
+_LOG = jnp.asarray(gf256.LOG_TABLE, dtype=jnp.int32)  # [256]
+
+
+# ---------------------------------------------------------------------------
+# Bit packing helpers (LSB-first, matching gf256.expand_to_bit_matrix)
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_bits(x: jax.Array) -> jax.Array:
+    """[..., k, L] uint8 -> [..., 8k, L] int8 (row order i*8 + bit)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    bits = (x[..., :, None, :] >> shifts) & 1
+    new_shape = x.shape[:-2] + (x.shape[-2] * 8, x.shape[-1])
+    return bits.reshape(new_shape).astype(jnp.int8)
+
+
+def bits_to_bytes(bits: jax.Array) -> jax.Array:
+    """[..., 8m, L] int -> [..., m, L] uint8."""
+    m8, L = bits.shape[-2], bits.shape[-1]
+    grouped = bits.reshape(bits.shape[:-2] + (m8 // 8, 8, L)).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
+    return jnp.sum(grouped * weights, axis=-2, dtype=jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Gather path (VPU): direct table formulation
+# ---------------------------------------------------------------------------
+
+
+def gf_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Element-wise GF(2^8) product."""
+    a32 = a.astype(jnp.int32)
+    b32 = b.astype(jnp.int32)
+    out = _EXP[_LOG[a32] + _LOG[b32]]
+    return jnp.where((a32 == 0) | (b32 == 0), 0, out).astype(jnp.uint8)
+
+
+def gf_matmul_gather(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[m, k] x [k, L] GF matmul via gathers + XOR reduction."""
+    prod = gf_mul(a[:, :, None], b[None, :, :])  # [m, k, L]
+    return jax.lax.reduce(
+        prod,
+        np.uint8(0),
+        lambda x, y: jax.lax.bitwise_xor(x, y),
+        dimensions=(1,),
+    )
+
+
+# ---------------------------------------------------------------------------
+# MXU path: GF(2) bit-matmul
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=512)
+def _bit_matrix_for(matrix_bytes: bytes, m: int, k: int) -> np.ndarray:
+    mat = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(m, k)
+    return gf256.expand_to_bit_matrix(mat).astype(np.int8)
+
+
+def bit_matrix(matrix: np.ndarray) -> np.ndarray:
+    """[m, k] GF(2^8) matrix -> [8m, 8k] int8 GF(2) matrix (cached).
+
+    Returns host numpy (never a traced value): callers hand it to jitted
+    functions as an argument, keeping caches trace-free.
+    """
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    return _bit_matrix_for(matrix.tobytes(), *matrix.shape)
+
+
+@partial(jax.jit, static_argnames=())
+def _bits_matmul(abits: jax.Array, data: jax.Array) -> jax.Array:
+    dbits = bytes_to_bits(data)  # [8k, L]
+    acc = jax.lax.dot_general(
+        abits,
+        dbits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return bits_to_bytes(acc & 1)
+
+
+def gf_matmul_bits(matrix: np.ndarray, data: jax.Array) -> jax.Array:
+    """[m, k] static GF matrix x [k, L] device data, via the MXU.
+
+    `matrix` is host-static (an RS encode/decode matrix); `data` may be
+    any traced array.
+    """
+    return _bits_matmul(bit_matrix(matrix), data)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: fused expand -> matmul -> parity -> pack
+# ---------------------------------------------------------------------------
+
+
+def _gf_kernel(abits_ref, d_ref, out_ref):
+    # d_ref: [k, TL] uint8 -> bits [8k, TL]
+    d = d_ref[:]
+    k, tl = d.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    dbits = ((d[:, None, :] >> shifts) & 1).reshape(8 * k, tl).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        abits_ref[:],
+        dbits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    bits = (acc & 1).astype(jnp.uint8)  # [8m, TL]
+    m8 = bits.shape[0]
+    grouped = bits.reshape(m8 // 8, 8, tl)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :, None]
+    out_ref[:] = jnp.sum(grouped * weights, axis=1, dtype=jnp.uint8)
+
+
+@partial(jax.jit, static_argnames=("tile_l",))
+def _gf_matmul_pallas(abits: jax.Array, data: jax.Array, tile_l: int = 512):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m8, k8 = abits.shape
+    k, L = data.shape
+    assert k8 == 8 * k
+    grid = (pl.cdiv(L, tile_l),)
+    # real Mosaic lowering on TPU; interpreter on CPU test meshes
+    interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        _gf_kernel,
+        out_shape=jax.ShapeDtypeStruct((m8 // 8, L), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m8, k8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, tile_l), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (m8 // 8, tile_l), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(abits, data)
+
+
+def gf_matmul_pallas(matrix: np.ndarray, data: jax.Array, tile_l: int = 512):
+    """Pallas-fused GF matmul; pads L up to the lane tile."""
+    k, L = data.shape
+    pad = (-L) % tile_l
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, pad)))
+    out = _gf_matmul_pallas(bit_matrix(matrix), data, tile_l=tile_l)
+    return out[:, :L] if pad else out

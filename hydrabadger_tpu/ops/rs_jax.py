@@ -1,0 +1,99 @@
+"""Batched Reed-Solomon erasure coding on TPU.
+
+Lifts crypto/rs.py's per-instance encode/reconstruct into single device
+calls over a whole batch of Broadcast instances — the (instances x
+proposers) axis of SURVEY.md §2.3.  The batch folds into the matmul's
+column dimension, so one MXU pass encodes thousands of proposals:
+
+    encode:      [B, k, L] -> [B, n, L]   (parity = A_bits @ bits(data))
+    reconstruct: [B, k, L] surviving shards (same survivor pattern
+                 across the batch) -> [B, k, L] data rows
+
+Bit-equal to the CPU reference (tests/test_ops_gf.py) — a hard protocol
+requirement: every node must derive identical shards regardless of
+engine (SURVEY.md §7 hard part 4).
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import gf256
+from ..crypto.rs import encode_matrix
+from . import gf256_jax
+
+
+@lru_cache(maxsize=256)
+def _parity_bits(data_shards: int, parity_shards: int):
+    mat = np.asarray(encode_matrix(data_shards, parity_shards))[data_shards:]
+    return gf256_jax.bit_matrix(mat)
+
+
+@lru_cache(maxsize=512)
+def _decode_bits(data_shards: int, parity_shards: int, rows: tuple):
+    """Bit matrix recovering the k data rows from the given survivor rows."""
+    mat = np.asarray(encode_matrix(data_shards, parity_shards))
+    sub = mat[list(rows)]
+    inv = gf256.mat_inv(sub)
+    return gf256_jax.bit_matrix(inv)
+
+
+@partial(jax.jit, static_argnames=("parity_shards", "use_pallas"))
+def _encode_batch(data, abits, parity_shards, use_pallas=False):
+    B, k, L = data.shape
+    flat = jnp.transpose(data, (1, 0, 2)).reshape(k, B * L)
+    if use_pallas:
+        pad = (-(B * L)) % 512
+        padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+        parity = gf256_jax._gf_matmul_pallas(abits, padded)[:, : B * L]
+    else:
+        parity = gf256_jax._bits_matmul(abits, flat)
+    parity = jnp.transpose(parity.reshape(parity_shards, B, L), (1, 0, 2))
+    return jnp.concatenate([data, parity], axis=1)
+
+
+def rs_encode_batch(
+    data, data_shards: int, parity_shards: int, use_pallas: bool = False
+):
+    """[B, k, L] uint8 -> [B, k+p, L]: systematic batch encode on device."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    if data.ndim != 3 or data.shape[1] != data_shards:
+        raise ValueError(f"expected [B, {data_shards}, L], got {data.shape}")
+    abits = _parity_bits(data_shards, parity_shards)
+    return _encode_batch(data, abits, parity_shards, use_pallas)
+
+
+@partial(jax.jit, static_argnames=("data_shards", "use_pallas"))
+def _reconstruct_batch(shards, dbits, data_shards, use_pallas):
+    B, k, L = shards.shape
+    flat = jnp.transpose(shards, (1, 0, 2)).reshape(k, B * L)
+    if use_pallas:
+        pad = (-(B * L)) % 512
+        padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+        out = gf256_jax._gf_matmul_pallas(dbits, padded)[:, : B * L]
+    else:
+        out = gf256_jax._bits_matmul(dbits, flat)
+    return jnp.transpose(out.reshape(data_shards, B, L), (1, 0, 2))
+
+
+def rs_reconstruct_batch(
+    surviving,
+    rows,
+    data_shards: int,
+    parity_shards: int,
+    use_pallas: bool = False,
+):
+    """Recover data rows for a batch sharing one survivor pattern.
+
+    surviving: [B, k, L] — the shards at indices `rows` (sorted, length k).
+    Returns [B, k, L] original data rows.
+    """
+    rows = tuple(int(r) for r in rows)
+    if len(rows) != data_shards:
+        raise ValueError(f"need exactly {data_shards} survivor rows")
+    surviving = jnp.asarray(surviving, dtype=jnp.uint8)
+    dbits = _decode_bits(data_shards, parity_shards, rows)
+    return _reconstruct_batch(surviving, dbits, data_shards, use_pallas)

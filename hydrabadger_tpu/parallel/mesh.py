@@ -1,0 +1,144 @@
+"""Multi-chip scale-out: the simulator's array plane on a device mesh.
+
+SURVEY.md §5.8's "sim/batch plane": when a simulation spans chips,
+message passing stops being sockets and becomes collectives over a
+`jax.sharding.Mesh`.  The mapping for Reliable Broadcast dissemination
+(§3.3's hot loop) is exact:
+
+  - the *nodes* axis of the simulated network shards across devices;
+  - RS-encoding every proposer's payload is local MXU work;
+  - "send shard j of proposal i to node j" — the reference's N^2 Value
+    messages over TCP (peer.rs wire_to_all) — is one `all_to_all` over
+    the node axis, riding ICI instead of loopback sockets;
+  - decoding at each node after "receiving" k shards is again local.
+
+Instances (independent consensus universes) are a second, purely
+data-parallel axis: `shard_map` over it needs no collectives at all.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import gf256_jax, rs_jax
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "nodes") -> Mesh:
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    return Mesh(np.array(devices[:n]), (axis,))
+
+
+def broadcast_round_sharded(
+    proposals: jax.Array,
+    data_shards: int,
+    parity_shards: int,
+    mesh: Mesh,
+    axis: str = "nodes",
+):
+    """One tensorized RBC dissemination round over a device mesh.
+
+    proposals: [N, k, L] — node i's payload, pre-split into k data rows.
+    N must equal data_shards + parity_shards (one shard per node) and be
+    divisible by the mesh size.
+
+    Returns (received, decoded):
+      received: [N_shards_local-major] = [N, N/n_dev ... ] arranged so
+        device d holds, for every proposer, the shard rows owned by its
+        local nodes — the post-"network" state.
+      decoded:  [N, k, L] every proposal reconstructed at every device
+        from the first k shard columns (gathered over the mesh),
+        verifying totality.
+    Collectives: all_to_all (dissemination) + all_gather (decode quorum).
+    """
+    n_total = data_shards + parity_shards
+    N, k, L = proposals.shape
+    if N != n_total:
+        raise ValueError("one shard per node: N must equal k + parity")
+    if N % mesh.devices.size:
+        raise ValueError("node count must divide the mesh")
+    abits = jnp.asarray(gf256_jax.bit_matrix(
+        np.asarray(rs_jax.encode_matrix(data_shards, parity_shards))[data_shards:]
+    ))
+    dec_rows = tuple(range(data_shards))
+    dbits = jnp.asarray(rs_jax._decode_bits(data_shards, parity_shards, dec_rows))
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(None), P(None)),
+        # received: [proposer, shard-column, L] with shard columns
+        # distributed; decoded: node-sharded like the input
+        out_specs=(P(None, axis), P(axis)),
+    )
+    def step(local, abits_, dbits_):
+        # local: [N/n, k, L] — this device's nodes' proposals
+        nl, kk, ll = local.shape
+        flat = jnp.transpose(local, (1, 0, 2)).reshape(kk, nl * ll)
+        parity = gf256_jax._bits_matmul(abits_, flat)
+        parity = jnp.transpose(
+            parity.reshape(parity.shape[0], nl, ll), (1, 0, 2)
+        )
+        full = jnp.concatenate([local, parity], axis=1)  # [N/n, N, L]
+        # dissemination: shard axis scatters across devices, proposer
+        # axis gathers — the N^2 Value/Echo traffic as one collective
+        received = jax.lax.all_to_all(
+            full, axis, split_axis=1, concat_axis=0, tiled=True
+        )  # [N, N/n, L]: all proposers x locally-owned shard columns
+        # decode quorum: collect the first k shard columns of every
+        # proposal (any k suffice; k columns = k "echoing nodes")
+        all_shards = jax.lax.all_gather(
+            received, axis, axis=1, tiled=True
+        )  # [N, N, L]
+        quorum = all_shards[:, :kk, :]  # [N, k, L]
+        qflat = jnp.transpose(quorum, (1, 0, 2)).reshape(kk, N * ll)
+        data = gf256_jax._bits_matmul(dbits_, qflat)
+        decoded = jnp.transpose(data.reshape(kk, N, ll), (1, 0, 2))
+        # every device now holds all decoded payloads; return this
+        # device's slice to keep the output sharded like the input
+        me = jax.lax.axis_index(axis)
+        return received, jax.lax.dynamic_slice_in_dim(
+            decoded, me * nl, nl, axis=0
+        )
+
+    return step(proposals, abits, dbits)
+
+
+def instances_sharded_encode(
+    data: jax.Array,
+    data_shards: int,
+    parity_shards: int,
+    mesh: Mesh,
+    axis: str = "nodes",
+):
+    """[B, k, L] batch encode with the instance axis sharded over the
+    mesh — BASELINE configs 3-5's scale-out, zero collectives."""
+    abits = jnp.asarray(
+        gf256_jax.bit_matrix(
+            np.asarray(rs_jax.encode_matrix(data_shards, parity_shards))[
+                data_shards:
+            ]
+        )
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(None)),
+        out_specs=P(axis),
+    )
+    def step(local, abits_):
+        B, k, L = local.shape
+        flat = jnp.transpose(local, (1, 0, 2)).reshape(k, B * L)
+        parity = gf256_jax._bits_matmul(abits_, flat)
+        parity = jnp.transpose(
+            parity.reshape(parity.shape[0], B, L), (1, 0, 2)
+        )
+        return jnp.concatenate([local, parity], axis=1)
+
+    return step(data, abits)

@@ -1,17 +1,30 @@
-"""Test configuration.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
-Tests run on a virtual 8-device CPU mesh so multi-chip sharding
-(shard_map over jax.sharding.Mesh) is exercised without TPU hardware.
-Env must be set before jax is imported anywhere.
+Multi-chip sharding (shard_map over jax.sharding.Mesh) is exercised
+without TPU hardware.  The environment injects an `axon` TPU plugin via
+sitecustomize *before* this file runs, and initializing that backend can
+block on a remote tunnel — so we (a) set XLA_FLAGS before any backend is
+created, (b) switch jax to the cpu platform at runtime, and (c) drop the
+axon factory so nothing ever dials it from tests.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax-less environments still test
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

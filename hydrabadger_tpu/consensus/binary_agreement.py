@@ -113,6 +113,8 @@ class BinaryAgreement:
         return (MSG, rnd, content)
 
     def _send_bval(self, rnd: int, b: bool) -> Step:
+        if self.netinfo.our_index() is None:
+            return Step()  # observers track, never speak
         state = self._state(rnd)
         if b in state.sent_bval:
             return Step()
@@ -132,7 +134,8 @@ class BinaryAgreement:
         if count == 2 * f + 1:
             first = not state.bin_values
             state.bin_values.add(b)
-            if first and rnd == self.round and not state.aux_sent:
+            if (first and rnd == self.round and not state.aux_sent
+                    and self.netinfo.our_index() is not None):
                 state.aux_sent = True
                 step.broadcast(self._msg(rnd, ("aux", b)))
                 step.extend(self._handle_aux(rnd, state, self.netinfo.our_id, b))
@@ -161,6 +164,10 @@ class BinaryAgreement:
         vals = frozenset(
             v for s, v in state.received_aux.items() if v in state.bin_values
         )
+        if self.netinfo.our_index() is None:
+            # observer: move straight to the coin phase bookkeeping
+            state.conf_sent = True
+            return self._check_conf(rnd, state)
         state.conf_sent = True
         step = Step().broadcast(self._msg(rnd, ("conf", tuple(sorted(vals)))))
         return step.extend(
@@ -256,7 +263,8 @@ class BinaryAgreement:
         state = self._state(rnd)
         step = Step()
         # bin_values may already be populated; trigger aux if due
-        if state.bin_values and not state.aux_sent:
+        if (state.bin_values and not state.aux_sent
+                and self.netinfo.our_index() is not None):
             b = next(iter(state.bin_values))
             state.aux_sent = True
             step.broadcast(self._msg(rnd, ("aux", b)))
@@ -276,7 +284,7 @@ class BinaryAgreement:
         self.terminated = True
         step = Step()
         step.output.append(b)
-        if not self.term_sent:
+        if not self.term_sent and self.netinfo.our_index() is not None:
             self.term_sent = True
             step.broadcast(self._msg(self.round, ("term", b)))
         return step

@@ -92,6 +92,9 @@ class HoneyBadger:
 
     @guarded_handler("hb")
     def handle_message(self, sender, message) -> Step:
+        if not self.netinfo.is_validator(sender):
+            # only validators participate; observers just listen
+            return Step().fault(sender, "hb: message from non-validator")
         _tag, epoch, inner = message[0], int(message[1]), message[2]
         if epoch < self.epoch:
             return Step()  # stale epoch; already concluded

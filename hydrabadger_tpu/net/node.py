@@ -1,0 +1,687 @@
+"""The TCP node runtime: state machine, event handler, public API.
+
+Re-creates the reference's L3-L5 (SURVEY.md §1) on asyncio:
+
+  - `Config` with the reference's defaults (hydrabadger.rs:35-78)
+  - `Hydrabadger` public API: run_node / propose_user_contribution /
+    vote_for / register_epoch_listener / batch_queue / state / peers
+    (hydrabadger.rs:127-603)
+  - node state machine Disconnected -> AwaitingMorePeers ->
+    GeneratingKeys -> Validator, or -> Observer via an active network's
+    join info (state.rs:26-105 semantics)
+  - bootstrap DKG over the wire with the reference's strict completion
+    gate (all n parts, >= n^2 acks; key_gen.rs:373-386)
+  - the single-consumer event handler: every socket task funnels into
+    one internal queue, preserving the reference's one-lock-per-poll
+    core (handler.rs:630; SURVEY.md §2.3)
+  - dynamic membership: hello -> vote_to_add; disconnect ->
+    vote_to_remove (handler.rs:77-88, 397-426); observers promoted when
+    their committed change completes (handler.rs:698-715)
+
+The consensus core is the same sans-io DynamicHoneyBadger the simulator
+runs — the network plane only moves bytes.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..consensus.dynamic_honey_badger import DhbBatch, DynamicHoneyBadger, JoinPlan
+from ..consensus.types import NetworkInfo, Step
+from ..crypto.dkg import Ack, Part, SyncKeyGen
+from ..crypto.threshold import PublicKey, SecretKey
+from ..utils.ids import InAddr, OutAddr, Uid
+from . import wire
+from .peer import Peer, Peers
+from .wire import WireMessage, WireStream
+
+log = logging.getLogger("hydrabadger_tpu.net")
+
+
+@dataclass
+class Config:
+    """Reference defaults: hydrabadger.rs:35-45."""
+
+    txn_gen_count: int = 5
+    txn_gen_interval_ms: int = 5000
+    txn_gen_bytes: int = 2
+    keygen_peer_count: int = 2
+    output_extra_delay_ms: int = 0
+    start_epoch: int = 0
+    # crypto tier (the reference is always "full"; the fast tiers exist
+    # for tests and CPU-bound development)
+    encrypt: bool = True
+    coin_mode: str = "threshold"
+    verify_shares: bool = True
+    wire_sign: bool = True  # BLS-sign/verify every frame (lib.rs:429-447)
+
+
+class KeyGenMachine:
+    """Async wrapper around one SyncKeyGen session over the wire.
+
+    The reference's key_gen::Machine (key_gen.rs:59-123): await peers,
+    generate, complete — with the strict gate of key_gen.rs:373-386
+    (every proposal complete and >= n^2 acks observed).
+    """
+
+    def __init__(self, instance_id: tuple):
+        self.instance_id = instance_id
+        self.state = "awaiting_peers"
+        self.kg: Optional[SyncKeyGen] = None
+        self.ack_count = 0
+        self.n = 0
+        self.event_queue: asyncio.Queue = asyncio.Queue()
+        # acks that raced ahead of their part (the reference queues these
+        # until the part count is complete, key_gen.rs:96-114)
+        self.pending_acks: List[tuple] = []
+
+    def start(self, our_uid, our_sk, pub_keys: Dict, rng) -> Part:
+        self.n = len(pub_keys)
+        threshold = self.n // 3
+        self.kg = SyncKeyGen(our_uid, our_sk, pub_keys, threshold, rng)
+        self.state = "generating"
+        return self.kg.propose()
+
+    def handle_part(self, sender, part: Part):
+        outcome = self.kg.handle_part(sender, part)
+        if outcome.valid:
+            self._drain_pending_acks()
+        return outcome
+
+    def handle_ack(self, sender, ack: Ack):
+        if ack.proposer_idx not in self.kg.parts:
+            self.pending_acks.append((sender, ack))
+            from ..crypto.dkg import AckOutcome
+
+            return AckOutcome(True)  # queued, not judged yet
+        outcome = self.kg.handle_ack(sender, ack)
+        if outcome.valid:
+            self.ack_count += 1
+        return outcome
+
+    def _drain_pending_acks(self) -> None:
+        pending, self.pending_acks = self.pending_acks, []
+        for sender, ack in pending:
+            self.handle_ack(sender, ack)
+
+    def is_complete(self) -> bool:
+        return (
+            self.kg is not None
+            and self.kg.count_complete() == self.n
+            and self.ack_count >= self.n * self.n
+        )
+
+    def generate(self):
+        self.state = "complete"
+        return self.kg.generate()
+
+
+class Hydrabadger:
+    """A consensus node (the reference's clone-able handle + runtime)."""
+
+    def __init__(
+        self,
+        bind: InAddr,
+        config: Optional[Config] = None,
+        uid: Optional[Uid] = None,
+        seed: Optional[int] = None,
+    ):
+        self.uid = uid or Uid()
+        self.bind = bind
+        self.cfg = config or Config()
+        self.rng = random.Random(seed if seed is not None else int.from_bytes(self.uid.bytes[:8], "big"))
+        self.secret_key = SecretKey.random(self.rng)
+        self.public_key = self.secret_key.public_key()
+        self.peers = Peers()
+        self.state = "disconnected"
+        self.dhb: Optional[DynamicHoneyBadger] = None
+        self.key_gen: Optional[KeyGenMachine] = None
+        self.user_key_gens: Dict[bytes, KeyGenMachine] = {}
+        # everything we broadcast for in-flight keygens, resent to peers
+        # whose handshake lands late (the reference keeps a wire retry
+        # queue for the same race, handler.rs:660-670)
+        self.keygen_outbox: List[WireMessage] = []
+        # keygen traffic that arrived before our own machine started
+        self.keygen_inbox: List[tuple] = []
+        self.iom_queue: List[tuple] = []  # messages before DHB exists
+        self.batch_queue: asyncio.Queue = asyncio.Queue()
+        self.batches: List[DhbBatch] = []
+        self.epoch_listeners: List[asyncio.Queue] = []
+        self.current_epoch = self.cfg.start_epoch
+        self._internal: asyncio.Queue = asyncio.Queue()
+        self._tasks: List[asyncio.Task] = []
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._stopped = asyncio.Event()
+        self._gen_txns: Optional[Callable[[int, int], List[bytes]]] = None
+
+    # -- public API (hydrabadger.rs:127-603) --------------------------------
+
+    @property
+    def our_id(self) -> bytes:
+        return self.uid.bytes
+
+    def is_validator(self) -> bool:
+        return self.dhb is not None and self.dhb.is_validator
+
+    def register_epoch_listener(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self.epoch_listeners.append(q)
+        return q
+
+    def propose_user_contribution(self, contribution: bytes) -> bool:
+        """Queue a contribution; False when not (yet) a validator."""
+        if not self.is_validator():
+            return False
+        self._internal.put_nowait(("api_propose", bytes(contribution)))
+        return True
+
+    def vote_for(self, change: tuple) -> bool:
+        if self.dhb is None:
+            return False
+        self._internal.put_nowait(("api_vote", tuple(change)))
+        return True
+
+    def new_key_gen_instance(self) -> asyncio.Queue:
+        """Start a user-scoped DKG among current validators; events
+        (('complete', pk_set, share) | ('failed', reason)) arrive on the
+        returned queue.  (reference: hydrabadger.rs:312-320)"""
+        machine = KeyGenMachine(("user", self.uid.bytes))
+        self._internal.put_nowait(("api_user_keygen", machine))
+        return machine.event_queue
+
+    async def run_node(
+        self,
+        remotes: Optional[List[OutAddr]] = None,
+        gen_txns: Optional[Callable[[int, int], List[bytes]]] = None,
+    ) -> None:
+        """Start the server, dial remotes, run until stop()."""
+        await self.start(remotes, gen_txns)
+        await self._stopped.wait()
+
+    async def start(self, remotes=None, gen_txns=None) -> None:
+        self._gen_txns = gen_txns
+        self._server = await asyncio.start_server(
+            self._on_incoming, self.bind.host, self.bind.port
+        )
+        self._tasks.append(asyncio.create_task(self._handler_loop()))
+        if gen_txns is not None:
+            self._tasks.append(asyncio.create_task(self._generator_loop()))
+        for remote in remotes or []:
+            self._tasks.append(asyncio.create_task(self._connect_outgoing(remote)))
+        log.info("%s listening on %s", self.uid, self.bind)
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        if self._server is not None:
+            self._server.close()
+        self.peers.close_all()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    # -- connection plumbing ------------------------------------------------
+
+    async def _on_incoming(self, reader, writer) -> None:
+        addr = writer.get_extra_info("peername") or ("?", 0)
+        out_addr = OutAddr(addr[0], addr[1])
+        stream = WireStream(reader, writer, self.secret_key, self.cfg.wire_sign)
+        peer = Peer(out_addr, stream)
+        peer.start_pump()
+        self.peers.add(peer)
+        try:
+            first, _body, _sig = await stream.recv()
+            # the reference requires the first frame to be a hello
+            # (hydrabadger.rs:339)
+            if first.kind != "hello_request_change_add":
+                log.warning("first frame from %s was %s", out_addr, first.kind)
+                return
+            self._internal.put_nowait(("incoming_hello", peer, first))
+            await self._read_loop(peer, stream)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError, ValueError):
+            pass
+        finally:
+            self._drop_peer(peer)
+
+    async def _connect_outgoing(self, remote: OutAddr) -> None:
+        try:
+            reader, writer = await asyncio.open_connection(remote.host, remote.port)
+        except OSError as e:
+            log.warning("connect to %s failed: %r", remote, e)
+            return
+        stream = WireStream(
+            reader, writer, self.secret_key, self.cfg.wire_sign
+        )
+        peer = Peer(remote, stream, outgoing=True)
+        peer.start_pump()
+        self.peers.add(peer)
+        peer.send(
+            wire.hello_request_change_add(
+                self.uid, self.bind.host, self.bind.port, self.public_key
+            )
+        )
+        try:
+            await self._read_loop(peer, stream)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError, ValueError):
+            pass
+        finally:
+            self._drop_peer(peer)
+
+    async def _read_loop(self, peer: Peer, stream: WireStream) -> None:
+        while True:
+            msg, body, sig = await stream.recv()
+            self._internal.put_nowait(("peer_msg", peer, msg, body, sig))
+
+    def _drop_peer(self, peer: Peer) -> None:
+        if peer.out_addr in self.peers.by_addr:
+            self._internal.put_nowait(("peer_disconnect", peer))
+
+    # -- the single-consumer handler (handler.rs:621-783) -------------------
+
+    async def _handler_loop(self) -> None:
+        while True:
+            item = await self._internal.get()
+            try:
+                self._handle_internal(item)
+            except Exception:
+                log.exception("handler error on %s", item[0])
+
+    def _handle_internal(self, item: tuple) -> None:
+        kind = item[0]
+        if kind == "incoming_hello":
+            self._on_hello(item[1], item[2], incoming=True)
+        elif kind == "peer_msg":
+            self._on_peer_msg(item[1], item[2], item[3], item[4])
+        elif kind == "peer_disconnect":
+            self._on_disconnect(item[1])
+        elif kind == "api_propose":
+            if self.dhb is not None:
+                self._dispatch_step(self.dhb.propose(item[1], self.rng))
+        elif kind == "api_vote":
+            if self.dhb is not None:
+                self.dhb.vote_for(item[1])
+        elif kind == "api_user_keygen":
+            self._start_user_keygen(item[1])
+
+    # -- handshake / discovery ----------------------------------------------
+
+    def _net_state(self) -> tuple:
+        peers_info = tuple(
+            (p.uid.bytes, p.in_addr.host, p.in_addr.port, p.pk.to_bytes())
+            for p in self.peers.established()
+            if p.uid is not None and p.in_addr is not None and p.pk is not None
+        )
+        if self.dhb is not None:
+            plan = self.dhb.join_plan()
+            return (
+                "active",
+                plan.era,
+                plan.epoch,
+                tuple(plan.node_ids),
+                {k: v for k, v in plan.pub_keys.items()},
+                plan.pk_set_bytes,
+                plan.session_id,
+                peers_info,
+            )
+        if self.state == "generating_keys":
+            return ("generating_keys", peers_info)
+        return ("awaiting_more_peers", peers_info)
+
+    def _on_hello(self, peer: Peer, msg: WireMessage, incoming: bool) -> None:
+        uid_b, host, port, pk_b = msg.payload
+        uid = Uid(bytes(uid_b))
+        pk = PublicKey.from_bytes(bytes(pk_b))
+        if not self._resolve_duplicate(peer, uid):
+            return
+        peer.establish(uid, InAddr(str(host), int(port)), pk)
+        self.peers.establish(peer)
+        if self.state == "disconnected":
+            self.state = "awaiting_more_peers"
+        peer.send(
+            wire.welcome_received_change_add(
+                self.uid, self.bind.host, self.bind.port,
+                self.public_key, self._net_state(),
+            )
+        )
+        self._after_peer_established(uid, pk)
+
+    def _on_peer_msg(self, peer: Peer, msg: WireMessage, body: bytes, sig: bytes) -> None:
+        kind = msg.kind
+        if kind in wire.VERIFIED_KINDS and self.cfg.wire_sign:
+            # by now the handshake frames on this connection have been
+            # handled (FIFO), so the pk is installed — or never will be
+            if not peer.wire.verify(body, sig):
+                log.warning("bad %s signature from %s", kind, peer.out_addr)
+                return
+        if kind == "welcome_received_change_add":
+            uid_b, host, port, pk_b, net_state = msg.payload
+            uid = Uid(bytes(uid_b))
+            pk = PublicKey.from_bytes(bytes(pk_b))
+            if peer.state != "established":
+                if not self._resolve_duplicate(peer, uid):
+                    return
+                peer.establish(uid, InAddr(str(host), int(port)), pk)
+                self.peers.establish(peer)
+            if self.state == "disconnected":
+                self.state = "awaiting_more_peers"
+            self._on_net_state(net_state)
+            self._after_peer_established(uid, pk)
+        elif kind == "hello_from_validator":
+            uid_b, host, port, pk_b, net_state = msg.payload
+            uid = Uid(bytes(uid_b))
+            pk = PublicKey.from_bytes(bytes(pk_b))
+            if peer.state != "established":
+                if not self._resolve_duplicate(peer, uid):
+                    return
+                peer.establish(uid, InAddr(str(host), int(port)), pk)
+                self.peers.establish(peer)
+                self._after_peer_established(uid, pk)
+            self._on_net_state(net_state)
+        elif kind == "hello_request_change_add":
+            self._on_hello(peer, msg, incoming=False)
+        elif kind == "message":
+            src_b, payload = msg.payload
+            self._on_consensus_message(bytes(src_b), payload)
+        elif kind == "key_gen":
+            src_b, instance_id, payload = msg.payload
+            self._on_key_gen_message(bytes(src_b), tuple(instance_id), payload)
+        elif kind == "join_plan":
+            self._on_join_plan(msg.payload)
+        elif kind == "net_state_request":
+            peer.send(WireMessage("net_state", self._net_state()))
+        elif kind == "net_state":
+            self._on_net_state(msg.payload)
+        elif kind == "transaction":
+            if self.is_validator():
+                self._internal.put_nowait(("api_propose", bytes(msg.payload)))
+        elif kind == "goodbye":
+            peer.close()
+        elif kind == "ping":
+            peer.send(WireMessage("pong", None))
+
+    def _on_net_state(self, net_state) -> None:
+        tag = net_state[0]
+        if tag in ("awaiting_more_peers", "generating_keys"):
+            peers_info = net_state[1]
+            self._discover(peers_info)
+        elif tag == "active" and self.dhb is None:
+            (_tag, era, epoch, node_ids, pub_keys, pk_set_b, session, peers_info) = net_state
+            plan = JoinPlan(
+                era=int(era),
+                epoch=int(epoch),
+                node_ids=tuple(bytes(n) for n in node_ids),
+                pub_keys={bytes(k): bytes(v) for k, v in pub_keys.items()},
+                pk_set_bytes=bytes(pk_set_b),
+                session_id=bytes(session),
+            )
+            self._become_observer(plan)
+            self._discover(peers_info)
+
+    def _discover(self, peers_info) -> None:
+        """Dial newly-learned peers (handler.rs:377-393)."""
+        for uid_b, host, port, pk_b in peers_info:
+            uid = Uid(bytes(uid_b))
+            if uid == self.uid or self.peers.get_by_uid(uid) is not None:
+                continue
+            remote = OutAddr(str(host), int(port))
+            if remote in self.peers.by_addr:
+                continue
+            self._tasks.append(
+                asyncio.create_task(self._connect_outgoing(remote))
+            )
+
+    def _resolve_duplicate(self, peer: Peer, uid: Uid) -> bool:
+        """Keep one connection per node pair.  Both ends agree on the
+        survivor: the link dialled by the lexicographically-lower uid.
+        Returns False when `peer` is the redundant one (already closed)."""
+        if uid == self.uid:
+            peer.close()
+            self.peers.remove(peer)
+            return False
+        existing = self.peers.get_by_uid(uid)
+        if existing is None or existing is peer:
+            return True
+        keep_new = peer.outgoing == (self.uid.bytes < uid.bytes)
+        if keep_new:
+            self.peers.remove(existing)
+            existing.close()
+            return True
+        peer.close()
+        self.peers.remove(peer)
+        return False
+
+    def _after_peer_established(self, uid: Uid, pk: PublicKey) -> None:
+        # late handshake during keygen: ship the transcript so far
+        if self.keygen_outbox and self.dhb is None:
+            target = self.peers.get_by_uid(uid)
+            if target is not None:
+                for msg in self.keygen_outbox:
+                    target.send(msg)
+        if self.dhb is not None:
+            # active network: vote the newcomer in (handler.rs:77-88)
+            if self.dhb.is_validator and uid.bytes not in self.dhb.netinfo.node_ids:
+                self.dhb.vote_to_add(uid.bytes, pk)
+            return
+        if (
+            self.state == "awaiting_more_peers"
+            and self.peers.count_established() >= self.cfg.keygen_peer_count
+        ):
+            self._start_bootstrap_keygen()
+
+    # -- bootstrap keygen ----------------------------------------------------
+
+    def _keygen_pub_keys(self) -> Dict[bytes, PublicKey]:
+        pub_keys = {self.uid.bytes: self.public_key}
+        for p in self.peers.established():
+            if p.uid is not None and p.pk is not None:
+                pub_keys[p.uid.bytes] = p.pk
+        return pub_keys
+
+    def _start_bootstrap_keygen(self) -> None:
+        self.state = "generating_keys"
+        self.key_gen = KeyGenMachine(("builtin",))
+        part = self.key_gen.start(
+            self.uid.bytes, self.secret_key, self._keygen_pub_keys(), self.rng
+        )
+        # announce validator-hood + our part (key_gen.rs:257-271)
+        self.peers.wire_to_all(
+            wire.hello_from_validator(
+                self.uid, self.bind.host, self.bind.port,
+                self.public_key, self._net_state(),
+            )
+        )
+        self._broadcast_keygen(
+            ("builtin",), ("part", part.commit_bytes, tuple(part.enc_rows))
+        )
+        # self-handle our own part -> our own ack
+        outcome = self.key_gen.handle_part(self.uid.bytes, part)
+        if outcome.ack is not None:
+            self._broadcast_keygen(
+                ("builtin",),
+                ("ack", outcome.ack.proposer_idx, tuple(outcome.ack.enc_values)),
+            )
+            self.key_gen.handle_ack(self.uid.bytes, outcome.ack)
+        # replay keygen traffic that beat us here
+        pending, self.keygen_inbox = self.keygen_inbox, []
+        for src, instance_id, payload in pending:
+            self._on_key_gen_message(src, instance_id, payload)
+        self._maybe_finish_keygen(self.key_gen)
+
+    def _broadcast_keygen(self, instance_id: tuple, payload: tuple) -> None:
+        msg = wire.key_gen_message(self.uid, instance_id, payload)
+        self.keygen_outbox.append(msg)
+        self.peers.wire_to_all(msg)
+
+    def _on_key_gen_message(self, src: bytes, instance_id: tuple, payload) -> None:
+        if instance_id == ("builtin",):
+            machine = self.key_gen
+            if (machine is None or machine.kg is None) and self.dhb is None:
+                # peers ahead of us in the handshake dance; replayed when
+                # our own machine starts
+                self.keygen_inbox.append((src, instance_id, payload))
+                return
+        else:
+            machine = self.user_key_gens.get(bytes(instance_id[1]))
+        if machine is None or machine.kg is None:
+            return
+        tag = payload[0]
+        if tag == "part":
+            part = Part(bytes(payload[1]), tuple(bytes(r) for r in payload[2]))
+            outcome = machine.handle_part(src, part)
+            if outcome.valid and outcome.ack is not None:
+                self._broadcast_keygen(
+                    instance_id,
+                    ("ack", outcome.ack.proposer_idx, tuple(outcome.ack.enc_values)),
+                )
+                machine.handle_ack(self.uid.bytes, outcome.ack)
+            elif not outcome.valid:
+                log.warning("keygen part fault from %s: %s", src.hex()[:8], outcome.fault)
+        elif tag == "ack":
+            ack = Ack(int(payload[1]), tuple(bytes(v) for v in payload[2]))
+            outcome = machine.handle_ack(src, ack)
+            if not outcome.valid:
+                log.warning("keygen ack fault from %s: %s", src.hex()[:8], outcome.fault)
+        self._maybe_finish_keygen(machine)
+
+    def _maybe_finish_keygen(self, machine: KeyGenMachine) -> None:
+        if machine is None or not machine.is_complete():
+            return
+        pk_set, sk_share = machine.generate()
+        if machine.instance_id == ("builtin",):
+            node_ids = sorted(machine.kg.pub_keys.keys())
+            netinfo = NetworkInfo(self.uid.bytes, node_ids, pk_set, sk_share)
+            self.dhb = DynamicHoneyBadger(
+                self.uid.bytes,
+                self.secret_key,
+                netinfo,
+                dict(machine.kg.pub_keys),
+                era=self.cfg.start_epoch,
+                session_id=b"net",
+                encrypt=self.cfg.encrypt,
+                coin_mode=self.cfg.coin_mode,
+                verify_shares=self.cfg.verify_shares,
+                rng=self.rng,
+            )
+            self.key_gen = None
+            self.keygen_outbox = []
+            self.state = "validator"
+            log.info("%s validator: era %d, %d nodes", self.uid,
+                     self.cfg.start_epoch, len(node_ids))
+            # replay messages that arrived during keygen (state.rs:473-514)
+            pending, self.iom_queue = self.iom_queue, []
+            for src, payload in pending:
+                self._on_consensus_message(src, payload)
+        else:
+            machine.event_queue.put_nowait(("complete", pk_set, sk_share))
+
+    def _start_user_keygen(self, machine: KeyGenMachine) -> None:
+        if self.dhb is None:
+            machine.event_queue.put_nowait(("failed", "network not active"))
+            return
+        self.user_key_gens[self.uid.bytes] = machine
+        part = machine.start(
+            self.uid.bytes, self.secret_key, self._keygen_pub_keys(), self.rng
+        )
+        self._broadcast_keygen(
+            ("user", self.uid.bytes),
+            ("part", part.commit_bytes, tuple(part.enc_rows)),
+        )
+        outcome = machine.handle_part(self.uid.bytes, part)
+        if outcome.ack is not None:
+            self._broadcast_keygen(
+                ("user", self.uid.bytes),
+                ("ack", outcome.ack.proposer_idx, tuple(outcome.ack.enc_values)),
+            )
+            machine.handle_ack(self.uid.bytes, outcome.ack)
+
+    # -- consensus plumbing ---------------------------------------------------
+
+    def _become_observer(self, plan: JoinPlan) -> None:
+        self.dhb = DynamicHoneyBadger.from_join_plan(
+            self.uid.bytes,
+            self.secret_key,
+            plan,
+            encrypt=self.cfg.encrypt,
+            coin_mode=self.cfg.coin_mode,
+            verify_shares=self.cfg.verify_shares,
+            rng=self.rng,
+        )
+        self.state = "observer"
+        log.info("%s observer at era %d epoch %d", self.uid, plan.era, plan.epoch)
+        pending, self.iom_queue = self.iom_queue, []
+        for src, payload in pending:
+            self._on_consensus_message(src, payload)
+
+    def _on_consensus_message(self, src: bytes, payload) -> None:
+        if self.dhb is None:
+            self.iom_queue.append((src, payload))
+            return
+        step = self.dhb.handle_message(src, payload)
+        self._dispatch_step(step)
+
+    def _dispatch_step(self, step: Step) -> None:
+        if step is None:
+            return
+        for tm in step.messages:
+            msg = wire.consensus_message(self.uid, tm.message)
+            if tm.target.kind == "nodes":
+                for nid in tm.target.nodes:
+                    self.peers.wire_to(Uid(bytes(nid)), msg)
+            else:
+                # all / all_except: broadcast (observers need the traffic
+                # too — deliberately mirrors the reference, peer.rs:567)
+                self.peers.wire_to_all(msg)
+        for fault in step.fault_log:
+            log.debug("fault: %s %s", str(fault.node_id)[:16], fault.kind)
+        for batch in step.output:
+            if isinstance(batch, DhbBatch):
+                self._on_batch(batch)
+        if self.state == "observer" and self.dhb is not None and self.dhb.is_validator:
+            self.state = "validator"
+            log.info("%s promoted to validator (era %d)", self.uid, self.dhb.era)
+
+    def _on_batch(self, batch: DhbBatch) -> None:
+        self.batches.append(batch)
+        self.current_epoch = batch.epoch + 1
+        self.batch_queue.put_nowait(batch)
+        if batch.join_plan is not None:
+            self.peers.wire_to_all(
+                WireMessage("join_plan", batch.join_plan.wire())
+            )
+        for q in self.epoch_listeners:
+            q.put_nowait(self.current_epoch)
+
+    def _on_join_plan(self, payload) -> None:
+        if self.dhb is None:
+            self._become_observer(JoinPlan.from_wire(payload))
+
+    def _on_disconnect(self, peer: Peer) -> None:
+        self.peers.remove(peer)
+        peer.close()
+        if (
+            peer.uid is not None
+            and self.dhb is not None
+            and self.dhb.is_validator
+            and peer.uid.bytes in self.dhb.netinfo.node_ids
+        ):
+            # vote the dead validator out (handler.rs:397-426)
+            self.dhb.vote_to_remove(peer.uid.bytes)
+
+    # -- workload generator (hydrabadger.rs:431-476) -------------------------
+
+    async def _generator_loop(self) -> None:
+        while not self._stopped.is_set():
+            await asyncio.sleep(self.cfg.txn_gen_interval_ms / 1000)
+            if self.cfg.output_extra_delay_ms:
+                await asyncio.sleep(self.cfg.output_extra_delay_ms / 1000)
+            if self.is_validator() and self._gen_txns is not None:
+                txns = self._gen_txns(
+                    self.cfg.txn_gen_count, self.cfg.txn_gen_bytes
+                )
+                from ..utils import codec
+
+                self._internal.put_nowait(
+                    ("api_propose", codec.encode(tuple(txns)))
+                )

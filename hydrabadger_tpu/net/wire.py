@@ -1,0 +1,173 @@
+"""Signed, length-delimited wire protocol for the real-network plane.
+
+Re-creates the reference's L1 (SURVEY.md §1): every frame is a
+canonically-encoded message wrapped with a BLS signature
+(`SignedWireMessage`, lib.rs:350-355), length-prefixed on a TCP stream
+(LengthDelimitedCodec, lib.rs:359), signed on send (lib.rs:429-447) and
+signature-verified on receive for consensus/key-gen kinds
+(lib.rs:397-423).
+
+Message kinds (reference WireMessageKind, lib.rs:250-270 — same
+semantic surface, our own encoding):
+
+  hello_request_change_add  — dialler's greeting; asks to join
+  welcome_received_change_add — listener's reply with a NetworkState
+  hello_from_validator      — validator's greeting during key-gen
+  goodbye                   — graceful disconnect
+  message                   — consensus payload (signed+verified)
+  key_gen                   — DKG Part/Ack (signed+verified)
+  join_plan                 — committed JoinPlan broadcast
+  net_state_request / net_state — discovery gossip
+  transaction               — user txn relay
+  ping/pong                 — liveness
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+from ..crypto.threshold import PublicKey, SecretKey, Signature
+from ..utils import codec
+from ..utils.ids import Uid
+
+MAX_FRAME = 64 * 1024 * 1024
+
+# kinds whose payload must be signature-verified (reference verifies
+# Message/KeyGen, lib.rs:406-416)
+VERIFIED_KINDS = frozenset({"message", "key_gen"})
+
+KINDS = frozenset(
+    {
+        "hello_request_change_add",
+        "welcome_received_change_add",
+        "hello_from_validator",
+        "goodbye",
+        "message",
+        "key_gen",
+        "join_plan",
+        "net_state_request",
+        "net_state",
+        "transaction",
+        "ping",
+        "pong",
+    }
+)
+
+
+@dataclass(frozen=True)
+class WireMessage:
+    kind: str
+    payload: Any  # codec-encodable
+
+    def encode(self) -> bytes:
+        return codec.encode((self.kind, self.payload))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "WireMessage":
+        kind, payload = codec.decode(raw)
+        if kind not in KINDS:
+            raise ValueError(f"unknown wire kind {kind!r}")
+        return cls(kind, payload)
+
+
+class WireError(ConnectionError):
+    pass
+
+
+class WireStream:
+    """Framed signed messages over an asyncio stream pair."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        secret_key: SecretKey,
+        sign_frames: bool = True,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.secret_key = secret_key
+        self.sign_frames = sign_frames
+        self.peer_pk: Optional[PublicKey] = None  # set after handshake
+
+    async def send(self, msg: WireMessage) -> None:
+        body = msg.encode()
+        sig = self.secret_key.sign(body).to_bytes() if self.sign_frames else b""
+        frame = codec.encode((body, sig))
+        if len(frame) > MAX_FRAME:
+            raise WireError("frame too large")
+        self.writer.write(len(frame).to_bytes(4, "big") + frame)
+        await self.writer.drain()
+
+    async def recv(self) -> Tuple[WireMessage, bytes, bytes]:
+        """Read one frame.  Returns (message, body, signature) — signature
+        verification happens at the *handler*, not here: the reader task
+        can race ahead of the handshake frames still queued for the
+        handler, so the pk may not be installed yet (per-connection FIFO
+        guarantees the handler sees the hello first).
+        """
+        header = await self.reader.readexactly(4)
+        length = int.from_bytes(header, "big")
+        if length > MAX_FRAME:
+            raise WireError("oversized frame")
+        frame = await self.reader.readexactly(length)
+        body, sig_bytes = codec.decode(frame)
+        msg = WireMessage.decode(bytes(body))
+        return msg, bytes(body), bytes(sig_bytes)
+
+    def verify(self, body: bytes, sig_bytes: bytes) -> bool:
+        """Check a frame's signature against the handshaken peer key."""
+        if self.peer_pk is None:
+            return False
+        try:
+            sig = Signature.from_bytes(sig_bytes)
+        except ValueError:
+            return False
+        return self.peer_pk.verify(sig, body)
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+# -- payload helpers --------------------------------------------------------
+
+
+def hello_request_change_add(uid: Uid, bind_host: str, bind_port: int, pk: PublicKey) -> WireMessage:
+    return WireMessage(
+        "hello_request_change_add",
+        (uid.bytes, bind_host, bind_port, pk.to_bytes()),
+    )
+
+
+def welcome_received_change_add(
+    uid: Uid, bind_host: str, bind_port: int, pk: PublicKey, net_state: tuple
+) -> WireMessage:
+    return WireMessage(
+        "welcome_received_change_add",
+        (uid.bytes, bind_host, bind_port, pk.to_bytes(), net_state),
+    )
+
+
+def hello_from_validator(
+    uid: Uid, bind_host: str, bind_port: int, pk: PublicKey, net_state: tuple
+) -> WireMessage:
+    return WireMessage(
+        "hello_from_validator",
+        (uid.bytes, bind_host, bind_port, pk.to_bytes(), net_state),
+    )
+
+
+def consensus_message(src: Uid, payload: tuple) -> WireMessage:
+    return WireMessage("message", (src.bytes, payload))
+
+
+def key_gen_message(src: Uid, instance_id: tuple, payload: tuple) -> WireMessage:
+    return WireMessage("key_gen", (src.bytes, instance_id, payload))
+
+
+def goodbye(uid: Uid) -> WireMessage:
+    return WireMessage("goodbye", (uid.bytes,))

@@ -1,0 +1,138 @@
+"""TCP node runtime tests: localhost multi-node soak (fast crypto tier).
+
+The in-process analogue of the reference's manual `./run-node 0..3`
+verification (README.md:12-25), but asserted instead of eyeballed.
+"""
+import asyncio
+import random
+
+import pytest
+
+from hydrabadger_tpu.net.node import Config, Hydrabadger
+from hydrabadger_tpu.net.wire import WireMessage
+from hydrabadger_tpu.utils import codec
+from hydrabadger_tpu.utils.ids import InAddr, OutAddr
+
+BASE_PORT = 43700
+
+
+def fast_config(**kw):
+    defaults = dict(
+        txn_gen_interval_ms=150,
+        keygen_peer_count=2,
+        encrypt=False,
+        coin_mode="hash",
+        verify_shares=False,
+        wire_sign=False,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def gen_txns(count, nbytes):
+    rng = random.Random()
+    return [bytes(rng.getrandbits(8) for _ in range(max(nbytes, 1))) for _ in range(count)]
+
+
+async def start_cluster(n, base_port, cfg=None):
+    nodes = []
+    for i in range(n):
+        node = Hydrabadger(
+            InAddr("127.0.0.1", base_port + i),
+            cfg or fast_config(),
+            seed=1000 + i,
+        )
+        remotes = [OutAddr("127.0.0.1", base_port + j) for j in range(i)][-2:]
+        await node.start(remotes, gen_txns)
+        nodes.append(node)
+        await asyncio.sleep(0.05)
+    return nodes
+
+
+async def stop_cluster(nodes):
+    for node in nodes:
+        await node.stop()
+
+
+async def wait_for(pred, timeout=30.0, interval=0.1):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+@pytest.mark.asyncio
+async def test_three_node_bootstrap_and_batches():
+    nodes = await start_cluster(3, BASE_PORT)
+    try:
+        ok = await wait_for(lambda: all(n.is_validator() for n in nodes))
+        assert ok, f"states: {[n.state for n in nodes]}"
+        ok = await wait_for(lambda: all(len(n.batches) >= 2 for n in nodes))
+        assert ok, f"batches: {[len(n.batches) for n in nodes]}"
+        # agreement on the common prefix
+        depth = min(len(n.batches) for n in nodes)
+        for e in range(depth):
+            keys = {
+                tuple(sorted(nodes[i].batches[e].contributions.items()))
+                for i in range(3)
+            }
+            assert len(keys) == 1, f"divergence at batch {e}"
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_user_contribution_and_epoch_listener():
+    nodes = await start_cluster(3, BASE_PORT + 10)
+    try:
+        assert await wait_for(lambda: all(n.is_validator() for n in nodes))
+        listener = nodes[0].register_epoch_listener()
+        payload = codec.encode((b"user-txn-xyz",))
+        assert nodes[1].propose_user_contribution(payload)
+        ok = await wait_for(
+            lambda: any(
+                b"user-txn-xyz" in bytes(v)
+                for n in nodes
+                for batch in n.batches
+                for v in batch.contributions.values()
+            )
+        )
+        assert ok, "user contribution never committed"
+        epoch = await asyncio.wait_for(listener.get(), timeout=10)
+        assert isinstance(epoch, int)
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_late_joiner_becomes_observer_then_validator():
+    nodes = await start_cluster(3, BASE_PORT + 20)
+    try:
+        assert await wait_for(lambda: all(n.is_validator() for n in nodes))
+        joiner = Hydrabadger(
+            InAddr("127.0.0.1", BASE_PORT + 23), fast_config(), seed=2000
+        )
+        await joiner.start([OutAddr("127.0.0.1", BASE_PORT + 20)], gen_txns)
+        nodes.append(joiner)
+        ok = await wait_for(lambda: joiner.dhb is not None, timeout=20)
+        assert ok, "joiner never became an observer"
+        assert joiner.state in ("observer", "validator")
+        ok = await wait_for(lambda: joiner.is_validator(), timeout=60)
+        assert ok, f"joiner stuck as {joiner.state} (era {joiner.dhb.era})"
+        # the promoted validator proposes and its contribution commits
+        marker = codec.encode((b"from-the-joiner",))
+        assert joiner.propose_user_contribution(marker)
+        ok = await wait_for(
+            lambda: any(
+                b"from-the-joiner" in bytes(v)
+                for batch in nodes[0].batches
+                for v in batch.contributions.values()
+            ),
+            timeout=30,
+        )
+        assert ok, "joiner's contribution never committed"
+    finally:
+        await stop_cluster(nodes)

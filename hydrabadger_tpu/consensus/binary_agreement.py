@@ -55,6 +55,7 @@ class BinaryAgreement:
         session_id: bytes,
         coin_mode: str = "threshold",
         verify_coin_shares: bool = True,
+        engine=None,
     ):
         if coin_mode not in ("threshold", "hash"):
             raise ValueError("coin_mode must be 'threshold' or 'hash'")
@@ -62,6 +63,7 @@ class BinaryAgreement:
         self.session_id = bytes(session_id)
         self.coin_mode = coin_mode
         self.verify_coin_shares = verify_coin_shares
+        self.engine = engine
         self.round = 0
         self.estimate: Optional[bool] = None
         self.decision: Optional[bool] = None
@@ -207,7 +209,10 @@ class BinaryAgreement:
             return self._on_coin(rnd, state, bit)
         if state.coin is None:
             state.coin = ThresholdSign(
-                self.netinfo, self._coin_doc(rnd), self.verify_coin_shares
+                self.netinfo,
+                self._coin_doc(rnd),
+                self.verify_coin_shares,
+                engine=self.engine,
             )
         step = state.coin.sign().map_messages(
             lambda m: self._msg(rnd, ("coin", m))
@@ -221,7 +226,10 @@ class BinaryAgreement:
             return Step()
         if state.coin is None:
             state.coin = ThresholdSign(
-                self.netinfo, self._coin_doc(rnd), self.verify_coin_shares
+                self.netinfo,
+                self._coin_doc(rnd),
+                self.verify_coin_shares,
+                engine=self.engine,
             )
         step = state.coin.handle_message(sender, inner).map_messages(
             lambda m: self._msg(rnd, ("coin", m))

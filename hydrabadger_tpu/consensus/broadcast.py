@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Dict, Hashable, Optional, TypeVar
 
-from ..crypto.rs import ReedSolomon
+from ..crypto.engine import get_engine
 from .merkle import MerkleTree, Proof
 from .types import NetworkInfo, Step, Target, guarded_handler
 
@@ -29,13 +29,13 @@ MSG_READY = "bc_ready"
 class Broadcast:
     """One broadcast instance: `proposer_id` disseminates one payload."""
 
-    def __init__(self, netinfo: NetworkInfo, proposer_id):
+    def __init__(self, netinfo: NetworkInfo, proposer_id, engine=None):
         self.netinfo = netinfo
         self.proposer_id = proposer_id
+        self.engine = get_engine(engine)
         n, f = netinfo.num_nodes, netinfo.num_faulty
         self.data_shards = n - 2 * f
         self.parity_shards = 2 * f
-        self.rs = ReedSolomon(self.data_shards, self.parity_shards)
         self.echo_sent = False
         self.ready_sent = False
         self.decided = False
@@ -53,7 +53,9 @@ class Broadcast:
             raise ValueError("only the proposer may broadcast")
         if self.value_received:
             return Step.empty()
-        shards = self.rs.encode_bytes(payload)
+        shards = self.engine.rs_encode_bytes(
+            payload, self.data_shards, self.parity_shards
+        )
         tree = MerkleTree(shards)
         step = Step()
         my_proof = None
@@ -162,15 +164,17 @@ class Broadcast:
             if proof.root == root:
                 slots[proof.index] = proof.value
         try:
-            payload = self.rs.reconstruct_data(slots)
+            payload = self.engine.rs_reconstruct_data(
+                slots, self.data_shards, self.parity_shards
+            )
         except ValueError:
             return Step().fault(
                 self.proposer_id, "broadcast: undecodable shards"
             )
         # Recompute the tree: catches a proposer whose shards don't form a
         # consistent coding (split-root attack).
-        full = ReedSolomon(self.data_shards, self.parity_shards).encode_bytes(
-            payload
+        full = self.engine.rs_encode_bytes(
+            payload, self.data_shards, self.parity_shards
         )
         if MerkleTree(full).root != root:
             self.decided = True

@@ -127,6 +127,7 @@ class DynamicHoneyBadger:
         coin_mode: str = "threshold",
         verify_shares: bool = True,
         rng=None,
+        engine=None,
     ):
         self.our_id = our_id
         self.our_sk = our_sk
@@ -138,6 +139,7 @@ class DynamicHoneyBadger:
         self.encrypt = encrypt
         self.coin_mode = coin_mode
         self.verify_shares = verify_shares
+        self.engine = engine
         self.rng = rng
         self.hb = self._make_hb()
         self.votes: Dict = {}  # voter -> change (latest committed vote)
@@ -161,6 +163,7 @@ class DynamicHoneyBadger:
             encrypt=self.encrypt,
             coin_mode=self.coin_mode,
             verify_shares=self.verify_shares,
+            engine=self.engine,
         )
 
     @classmethod
@@ -173,6 +176,7 @@ class DynamicHoneyBadger:
         coin_mode: str = "threshold",
         verify_shares: bool = True,
         rng=None,
+        engine=None,
     ) -> "DynamicHoneyBadger":
         """Instantiate as an observer from a committed JoinPlan
         (the reference's `new_joining` path, state.rs:200-250)."""
@@ -194,6 +198,7 @@ class DynamicHoneyBadger:
             coin_mode=coin_mode,
             verify_shares=verify_shares,
             rng=rng,
+            engine=engine,
         )
         dhb.hb.epoch = plan.epoch - plan.era  # skip the era's earlier epochs
         return dhb

@@ -15,6 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, Hashable, List, Optional, TypeVar
 
+from ..crypto.engine import get_engine
 from ..crypto.threshold import Ciphertext
 from .subset import Subset
 from .threshold_decrypt import ThresholdDecrypt
@@ -55,12 +56,14 @@ class HoneyBadger:
         coin_mode: str = "threshold",
         verify_shares: bool = True,
         start_epoch: int = 0,
+        engine=None,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
         self.encrypt = encrypt
         self.coin_mode = coin_mode
         self.verify_shares = verify_shares
+        self.engine = get_engine(engine)
         self.epoch = start_epoch
         self.epochs: Dict[int, _EpochState] = {}
         self.has_input: Dict[int, bool] = {}
@@ -76,11 +79,9 @@ class HoneyBadger:
             return Step()
         self.has_input[self.epoch] = True
         if self.encrypt:
-            payload = (
-                self.netinfo.pk_set.public_key()
-                .encrypt(bytes(contribution), rng)
-                .to_bytes()
-            )
+            payload = self.engine.encrypt(
+                self.netinfo.pk_set.public_key(), bytes(contribution), rng
+            ).to_bytes()
         else:
             payload = bytes(contribution)
         state = self._epoch_state(self.epoch)
@@ -130,6 +131,7 @@ class HoneyBadger:
                     self.session_id + b"/" + str(epoch).encode(),
                     coin_mode=self.coin_mode,
                     verify_coin_shares=self.verify_shares,
+                    engine=self.engine,
                 )
             )
         return self.epochs[epoch]
@@ -137,7 +139,9 @@ class HoneyBadger:
     def _decrypt_instance(self, state: _EpochState, proposer) -> ThresholdDecrypt:
         if proposer not in state.decrypts:
             state.decrypts[proposer] = ThresholdDecrypt(
-                self.netinfo, verify_shares=self.verify_shares
+                self.netinfo,
+                verify_shares=self.verify_shares,
+                engine=self.engine,
             )
         return state.decrypts[proposer]
 

@@ -29,6 +29,7 @@ class QueueingHoneyBadger:
         verify_shares: bool = True,
         rng=None,
         auto_propose: bool = True,
+        engine=None,
     ):
         self.netinfo = netinfo
         self.batch_size = max(1, batch_size)
@@ -41,6 +42,7 @@ class QueueingHoneyBadger:
             encrypt=encrypt,
             coin_mode=coin_mode,
             verify_shares=verify_shares,
+            engine=engine,
         )
         self.batches: List[Batch] = []
 

@@ -31,11 +31,13 @@ class Subset:
         session_id: bytes,
         coin_mode: str = "threshold",
         verify_coin_shares: bool = True,
+        engine=None,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
         self.broadcasts: Dict = {
-            nid: Broadcast(netinfo, nid) for nid in netinfo.node_ids
+            nid: Broadcast(netinfo, nid, engine=engine)
+            for nid in netinfo.node_ids
         }
         self.agreements: Dict = {
             nid: BinaryAgreement(
@@ -43,6 +45,7 @@ class Subset:
                 self.session_id + b"/" + str(i).encode(),
                 coin_mode=coin_mode,
                 verify_coin_shares=verify_coin_shares,
+                engine=engine,
             )
             for i, nid in enumerate(netinfo.node_ids)
         }

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Dict, Hashable, Optional, TypeVar
 
+from ..crypto.engine import get_engine
 from ..crypto.threshold import Ciphertext, DecryptionShare
 from .types import NetworkInfo, Step, guarded_handler
 
@@ -18,9 +19,12 @@ MSG_DEC_SHARE = "td_share"
 
 
 class ThresholdDecrypt:
-    def __init__(self, netinfo: NetworkInfo, verify_shares: bool = True):
+    def __init__(
+        self, netinfo: NetworkInfo, verify_shares: bool = True, engine=None
+    ):
         self.netinfo = netinfo
         self.verify_shares = verify_shares
+        self.engine = get_engine(engine)
         self.ciphertext: Optional[Ciphertext] = None
         self.shares: Dict = {}
         self.pending: Dict = {}  # shares that arrived before the ciphertext
@@ -36,7 +40,7 @@ class ThresholdDecrypt:
         self.ciphertext = ct
         step = Step()
         if self.netinfo.sk_share is not None:
-            share = self.netinfo.sk_share.decrypt_share(ct)
+            share = self.engine.decrypt_share(self.netinfo.sk_share, ct)
             step.broadcast((MSG_DEC_SHARE, share.to_bytes()))
             step.extend(self._handle_share(self.netinfo.our_id, share))
         for sender, share in list(self.pending.items()):
@@ -66,7 +70,9 @@ class ThresholdDecrypt:
             return Step().fault(sender, "threshold_decrypt: not a validator")
         if self.verify_shares:
             pk_share = self.netinfo.pk_set.public_key_share(idx)
-            if not pk_share.verify_decryption_share(share, self.ciphertext):
+            if not self.engine.verify_decryption_share(
+                pk_share, share, self.ciphertext
+            ):
                 return Step().fault(sender, "threshold_decrypt: invalid share")
         self.shares[sender] = share
         return self._try_decrypt()
@@ -75,7 +81,8 @@ class ThresholdDecrypt:
         t = self.netinfo.pk_set.threshold
         if self.terminated or len(self.shares) <= t:
             return Step()
-        plaintext = self.netinfo.pk_set.decrypt(
+        plaintext = self.engine.combine_decryption_shares(
+            self.netinfo.pk_set,
             {self.netinfo.index(nid): s for nid, s in self.shares.items()},
             self.ciphertext,
         )

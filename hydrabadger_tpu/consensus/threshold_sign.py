@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, Hashable, Optional, TypeVar
 
+from ..crypto.engine import get_engine
 from ..crypto.threshold import Signature, SignatureShare
 from .types import NetworkInfo, Step, guarded_handler
 
@@ -20,10 +21,17 @@ MSG_SHARE = "ts_share"
 
 
 class ThresholdSign:
-    def __init__(self, netinfo: NetworkInfo, doc: bytes, verify_shares: bool = True):
+    def __init__(
+        self,
+        netinfo: NetworkInfo,
+        doc: bytes,
+        verify_shares: bool = True,
+        engine=None,
+    ):
         self.netinfo = netinfo
         self.doc = bytes(doc)
         self.verify_shares = verify_shares
+        self.engine = get_engine(engine)
         self.shares: Dict = {}  # node -> SignatureShare
         self.had_input = False
         self.terminated = False
@@ -36,7 +44,7 @@ class ThresholdSign:
         self.had_input = True
         if self.netinfo.sk_share is None:
             return Step()
-        share = self.netinfo.sk_share.sign_share(self.doc)
+        share = self.engine.sign_share(self.netinfo.sk_share, self.doc)
         step = Step().broadcast((MSG_SHARE, share.to_bytes()))
         return step.extend(self._handle_share(self.netinfo.our_id, share))
 
@@ -57,8 +65,8 @@ class ThresholdSign:
         idx = self.netinfo.index(sender)
         if idx is None:
             return Step().fault(sender, "threshold_sign: not a validator")
-        if self.verify_shares and not self.netinfo.pk_set.verify_signature_share(
-            idx, share, self.doc
+        if self.verify_shares and not self.engine.verify_signature_share(
+            self.netinfo.pk_set, idx, share, self.doc
         ):
             return Step().fault(sender, "threshold_sign: invalid share")
         self.shares[sender] = share
@@ -68,20 +76,23 @@ class ThresholdSign:
         t = self.netinfo.pk_set.threshold
         if self.terminated or len(self.shares) <= t:
             return Step()
-        sig = self.netinfo.pk_set.combine_signatures(
-            {self.netinfo.index(nid): s for nid, s in self.shares.items()}
+        sig = self.engine.combine_signature_shares(
+            self.netinfo.pk_set,
+            {self.netinfo.index(nid): s for nid, s in self.shares.items()},
         )
         if self.verify_shares:
             # shares were individually verified; combination is sound
             pass
-        elif not self.netinfo.pk_set.public_key().verify(sig, self.doc):
+        elif not self.engine.verify(
+            self.netinfo.pk_set.public_key(), sig, self.doc
+        ):
             # optimistic path failed: a bad share slipped in.  Fall back to
             # verifying shares individually and flagging the culprit(s).
             step = Step()
             good = {}
             for nid, s in list(self.shares.items()):
-                if self.netinfo.pk_set.verify_signature_share(
-                    self.netinfo.index(nid), s, self.doc
+                if self.engine.verify_signature_share(
+                    self.netinfo.pk_set, self.netinfo.index(nid), s, self.doc
                 ):
                     good[nid] = s
                 else:
@@ -89,8 +100,9 @@ class ThresholdSign:
                     step.fault(nid, "threshold_sign: invalid share")
             if len(good) <= t:
                 return step
-            sig = self.netinfo.pk_set.combine_signatures(
-                {self.netinfo.index(nid): s for nid, s in good.items()}
+            sig = self.engine.combine_signature_shares(
+                self.netinfo.pk_set,
+                {self.netinfo.index(nid): s for nid, s in good.items()},
             )
             self.terminated = True
             self.signature = sig

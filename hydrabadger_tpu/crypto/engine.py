@@ -1,0 +1,217 @@
+"""Pluggable CryptoEngine — the backend boundary of the framework.
+
+BASELINE.json's north star prescribes that backend selection hangs off
+the node Config (the reference's "convert to builder" TODO at
+hydrabadger.rs:49 made load-bearing): every piece of crypto-heavy work
+the consensus cores perform — GF(2^8) Reed-Solomon coding inside
+Reliable Broadcast (hbbft::broadcast), BLS sign/verify on wire frames
+(lib.rs:411,434), threshold encrypt / decrypt-share / verify / combine
+(hbbft::threshold_decrypt, threshold_sign) — is reached through this
+interface, so the per-instance CPU reference path and the batched TPU
+path are interchangeable without touching protocol logic.
+
+Two engines ship:
+
+* ``CpuEngine`` — the default; per-instance numpy/C++ Reed-Solomon
+  (crypto/rs.py + native/gf256_rs.cpp) and the pure-Python BLS12-381
+  reference (crypto/threshold.py).  Matches the reference's
+  reed-solomon-erasure + threshold_crypto stack in role.
+* ``TpuEngine`` — batch entry points dispatch to jax/XLA kernels
+  (ops/rs_jax.py: one MXU bit-matmul per batch of instances; ops/bls_jax
+  for batched share combine).  Scalar entry points fall back to the CPU
+  path — single-message latency is not the TPU's job, batch throughput
+  is (SURVEY.md §7 hard part 3).
+
+Engines are stateless and hashable; one instance can serve every node in
+a simulation.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import threshold as th
+from .rs import ReedSolomon
+
+
+@lru_cache(maxsize=256)
+def _rs(data_shards: int, parity_shards: int) -> ReedSolomon:
+    return ReedSolomon(data_shards, parity_shards)
+
+
+class CpuEngine:
+    """Reference engine: per-instance CPU crypto (numpy / C++ / pure Python)."""
+
+    name = "cpu"
+
+    # -- Reed-Solomon erasure coding (hbbft::broadcast's inner loop) --------
+
+    def rs_encode_bytes(
+        self, payload: bytes, data_shards: int, parity_shards: int
+    ) -> List[bytes]:
+        """Shard one payload into data+parity shards (systematic)."""
+        return _rs(data_shards, parity_shards).encode_bytes(payload)
+
+    def rs_reconstruct_data(
+        self,
+        slots: Sequence[Optional[bytes]],
+        data_shards: int,
+        parity_shards: int,
+    ) -> bytes:
+        """Recover the payload from any `data_shards` surviving shards."""
+        return _rs(data_shards, parity_shards).reconstruct_data(slots)
+
+    def rs_encode_batch(
+        self, data, data_shards: int, parity_shards: int
+    ) -> np.ndarray:
+        """[B, k, L] -> [B, k+p, L]; the CPU baseline loops per instance."""
+        data = np.asarray(data, dtype=np.uint8)
+        rs = _rs(data_shards, parity_shards)
+        return np.stack([rs.encode(data[i]) for i in range(data.shape[0])])
+
+    def rs_reconstruct_batch(
+        self, surviving, rows: Sequence[int], data_shards: int, parity_shards: int
+    ) -> np.ndarray:
+        """[B, k, L] shards at indices `rows` -> [B, k, L] data rows."""
+        surviving = np.asarray(surviving, dtype=np.uint8)
+        rs = _rs(data_shards, parity_shards)
+        n = data_shards + parity_shards
+        rows = [int(r) for r in rows]
+        out = np.empty(
+            (surviving.shape[0], data_shards, surviving.shape[2]), np.uint8
+        )
+        for b in range(surviving.shape[0]):
+            slots: List[Optional[np.ndarray]] = [None] * n
+            for j, r in enumerate(rows):
+                slots[r] = surviving[b, j]
+            shards = rs.reconstruct(slots, data_only=True)
+            out[b] = np.stack(shards[:data_shards])
+        return out
+
+    # -- per-frame BLS signatures (lib.rs:411,434) --------------------------
+
+    def sign(self, sk: th.SecretKey, msg: bytes) -> th.Signature:
+        return sk.sign(msg)
+
+    def verify(self, pk: th.PublicKey, sig: th.Signature, msg: bytes) -> bool:
+        return pk.verify(sig, msg)
+
+    def verify_batch(
+        self, items: Sequence[Tuple[th.PublicKey, th.Signature, bytes]]
+    ) -> List[bool]:
+        """Verify many (pk, sig, msg) triples; the CPU path is one-by-one,
+        subclasses amortise (shared final exponentiation / TPU batch)."""
+        return [pk.verify(sig, msg) for pk, sig, msg in items]
+
+    # -- threshold encryption (hbbft::threshold_decrypt) --------------------
+
+    def encrypt(self, pk: th.PublicKey, msg: bytes, rng) -> th.Ciphertext:
+        return pk.encrypt(msg, rng)
+
+    def decrypt_share(
+        self, sk_share: th.SecretKeyShare, ct: th.Ciphertext
+    ) -> th.DecryptionShare:
+        return sk_share.decrypt_share(ct)
+
+    def verify_decryption_share(
+        self,
+        pk_share: th.PublicKeyShare,
+        share: th.DecryptionShare,
+        ct: th.Ciphertext,
+    ) -> bool:
+        return pk_share.verify_decryption_share(share, ct)
+
+    def combine_decryption_shares(
+        self,
+        pk_set: th.PublicKeySet,
+        shares: Mapping[int, th.DecryptionShare],
+        ct: th.Ciphertext,
+    ) -> bytes:
+        return pk_set.decrypt(shares, ct)
+
+    # -- threshold signatures (hbbft::threshold_sign / the common coin) -----
+
+    def sign_share(
+        self, sk_share: th.SecretKeyShare, msg: bytes
+    ) -> th.SignatureShare:
+        return sk_share.sign_share(msg)
+
+    def verify_signature_share(
+        self,
+        pk_set: th.PublicKeySet,
+        idx: int,
+        share: th.SignatureShare,
+        msg: bytes,
+    ) -> bool:
+        return pk_set.verify_signature_share(idx, share, msg)
+
+    def combine_signature_shares(
+        self,
+        pk_set: th.PublicKeySet,
+        shares: Mapping[int, th.SignatureShare],
+    ) -> th.Signature:
+        return pk_set.combine_signatures(shares)
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__}>"
+
+
+class TpuEngine(CpuEngine):
+    """Batched engine: batch entry points run as jax/XLA device kernels.
+
+    Imports of jax live inside methods so constructing the engine (e.g.
+    from a Config default) never forces device initialisation.
+    """
+
+    name = "tpu"
+
+    def rs_encode_batch(
+        self, data, data_shards: int, parity_shards: int
+    ) -> np.ndarray:
+        from ..ops import rs_jax
+
+        out = rs_jax.rs_encode_batch(data, data_shards, parity_shards)
+        return np.asarray(out)
+
+    def rs_reconstruct_batch(
+        self, surviving, rows: Sequence[int], data_shards: int, parity_shards: int
+    ) -> np.ndarray:
+        from ..ops import rs_jax
+
+        out = rs_jax.rs_reconstruct_batch(
+            surviving, tuple(int(r) for r in rows), data_shards, parity_shards
+        )
+        return np.asarray(out)
+
+_REGISTRY: Dict[str, type] = {"cpu": CpuEngine, "tpu": TpuEngine}
+_DEFAULT: Optional[CpuEngine] = None
+_INSTANCES: Dict[str, CpuEngine] = {}
+
+EngineLike = Union[None, str, CpuEngine]
+
+
+def get_engine(spec: EngineLike = None) -> CpuEngine:
+    """Resolve None (default) / a name ("cpu", "tpu") / an instance."""
+    global _DEFAULT
+    if spec is None:
+        if _DEFAULT is None:
+            _DEFAULT = CpuEngine()
+        return _DEFAULT
+    if isinstance(spec, str):
+        try:
+            cls = _REGISTRY[spec]
+        except KeyError:
+            raise ValueError(
+                f"unknown crypto engine {spec!r}; have {sorted(_REGISTRY)}"
+            ) from None
+        if spec not in _INSTANCES:
+            _INSTANCES[spec] = cls()
+        return _INSTANCES[spec]
+    return spec
+
+
+def register_engine(name: str, cls: type) -> None:
+    """Extension point for tests / alternative backends."""
+    _REGISTRY[name] = cls

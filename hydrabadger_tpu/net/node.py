@@ -57,6 +57,10 @@ class Config:
     coin_mode: str = "threshold"
     verify_shares: bool = True
     wire_sign: bool = True  # BLS-sign/verify every frame (lib.rs:429-447)
+    # CryptoEngine backend ("cpu" | "tpu") — BASELINE.json's north star
+    # hangs engine selection off this Config (hydrabadger.rs:49's builder
+    # TODO made load-bearing)
+    engine: str = "cpu"
 
 
 class KeyGenMachine:
@@ -71,7 +75,6 @@ class KeyGenMachine:
         self.instance_id = instance_id
         self.state = "awaiting_peers"
         self.kg: Optional[SyncKeyGen] = None
-        self.ack_count = 0
         self.n = 0
         self.event_queue: asyncio.Queue = asyncio.Queue()
         # acks that raced ahead of their part (the reference queues these
@@ -97,10 +100,15 @@ class KeyGenMachine:
             from ..crypto.dkg import AckOutcome
 
             return AckOutcome(True)  # queued, not judged yet
-        outcome = self.kg.handle_ack(sender, ack)
-        if outcome.valid:
-            self.ack_count += 1
-        return outcome
+        return self.kg.handle_ack(sender, ack)
+
+    @property
+    def ack_count(self) -> int:
+        """Distinct (sender, proposer) acks recorded — duplicates from
+        outbox replays on reconnect must not satisfy the n^2 gate."""
+        if self.kg is None:
+            return 0
+        return sum(len(st.acks) for st in self.kg.parts.values())
 
     def _drain_pending_acks(self) -> None:
         pending, self.pending_acks = self.pending_acks, []
@@ -383,9 +391,18 @@ class Hydrabadger:
             self._on_hello(peer, msg, incoming=False)
         elif kind == "message":
             src_b, payload = msg.payload
+            # the claimed source must be the authenticated connection peer
+            # (the reference asserts this, peer.rs:158): otherwise any
+            # connected peer could impersonate any validator
+            if peer.uid is None or bytes(src_b) != peer.uid.bytes:
+                log.warning("message src spoof from %s", peer.out_addr)
+                return
             self._on_consensus_message(bytes(src_b), payload)
         elif kind == "key_gen":
             src_b, instance_id, payload = msg.payload
+            if peer.uid is None or bytes(src_b) != peer.uid.bytes:
+                log.warning("key_gen src spoof from %s", peer.out_addr)
+                return
             self._on_key_gen_message(bytes(src_b), tuple(instance_id), payload)
         elif kind == "join_plan":
             self._on_join_plan(msg.payload)
@@ -524,6 +541,12 @@ class Hydrabadger:
                 return
         else:
             machine = self.user_key_gens.get(bytes(instance_id[1]))
+            if machine is None and self.dhb is not None:
+                # a peer-initiated instance: join it by proposing our own
+                # Part (the reference forwards these to the handler which
+                # instantiates a machine per InstanceId, handler.rs:523-538)
+                machine = KeyGenMachine(tuple(instance_id))
+                self._activate_user_keygen(machine)
         if machine is None or machine.kg is None:
             return
         tag = payload[0]
@@ -563,6 +586,7 @@ class Hydrabadger:
                 coin_mode=self.cfg.coin_mode,
                 verify_shares=self.cfg.verify_shares,
                 rng=self.rng,
+                engine=self.cfg.engine,
             )
             self.key_gen = None
             self.keygen_outbox = []
@@ -580,18 +604,26 @@ class Hydrabadger:
         if self.dhb is None:
             machine.event_queue.put_nowait(("failed", "network not active"))
             return
-        self.user_key_gens[self.uid.bytes] = machine
+        self._activate_user_keygen(machine)
+
+    def _activate_user_keygen(self, machine: KeyGenMachine) -> None:
+        """Begin our participation in a user key-gen instance: register,
+        propose our Part, broadcast it, and self-handle (key_gen.rs:195-218).
+        Used by the initiator (`new_key_gen_instance`) and by every other
+        node when the instance's first message arrives (handler.rs:523-538)."""
+        instance_id = machine.instance_id
+        self.user_key_gens[bytes(instance_id[1])] = machine
         part = machine.start(
             self.uid.bytes, self.secret_key, self._keygen_pub_keys(), self.rng
         )
         self._broadcast_keygen(
-            ("user", self.uid.bytes),
+            instance_id,
             ("part", part.commit_bytes, tuple(part.enc_rows)),
         )
         outcome = machine.handle_part(self.uid.bytes, part)
         if outcome.ack is not None:
             self._broadcast_keygen(
-                ("user", self.uid.bytes),
+                instance_id,
                 ("ack", outcome.ack.proposer_idx, tuple(outcome.ack.enc_values)),
             )
             machine.handle_ack(self.uid.bytes, outcome.ack)
@@ -607,6 +639,7 @@ class Hydrabadger:
             coin_mode=self.cfg.coin_mode,
             verify_shares=self.cfg.verify_shares,
             rng=self.rng,
+            engine=self.cfg.engine,
         )
         self.state = "observer"
         log.info("%s observer at era %d epoch %d", self.uid, plan.era, plan.epoch)

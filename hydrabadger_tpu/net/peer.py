@@ -79,9 +79,14 @@ class Peers:
         self.by_uid[peer.uid] = peer.out_addr
 
     def remove(self, peer: Peer) -> None:
-        self.by_addr.pop(peer.out_addr, None)
+        # identity check: a stale disconnect for an old connection must not
+        # evict a live replacement peer registered at the same address
+        if self.by_addr.get(peer.out_addr) is peer:
+            self.by_addr.pop(peer.out_addr, None)
         if peer.uid is not None and self.by_uid.get(peer.uid) == peer.out_addr:
-            self.by_uid.pop(peer.uid, None)
+            live = self.by_addr.get(peer.out_addr)
+            if live is None or live is peer:
+                self.by_uid.pop(peer.uid, None)
 
     def get_by_uid(self, uid: Uid) -> Optional[Peer]:
         addr = self.by_uid.get(uid)
@@ -97,14 +102,15 @@ class Peers:
     def wire_to_validators(self, msg: WireMessage, validator_uids) -> None:
         """Targeted multicast.  (The reference's equivalent falls back to
         broadcasting to everyone — peer.rs:567-575 FIXME; we honor the
-        target set when known, which observers rely on not to miss
-        traffic, so unknown uids simply get everything.)"""
-        sent = set()
-        for uid in validator_uids:
-            peer = self.get_by_uid(uid)
-            if peer is not None and peer.state == "established":
-                peer.send(msg)
-                sent.add(peer.out_addr)
+        target set when every uid resolves, and fall back to a full
+        broadcast when any does not, so unresolved validators never
+        silently miss traffic.)"""
+        targets = [self.get_by_uid(uid) for uid in validator_uids]
+        if any(p is None or p.state != "established" for p in targets):
+            self.wire_to_all(msg)
+            return
+        for peer in targets:
+            peer.send(msg)
 
     def wire_to(self, uid: Uid, msg: WireMessage) -> bool:
         peer = self.get_by_uid(uid)

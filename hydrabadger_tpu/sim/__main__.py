@@ -23,6 +23,12 @@ def main(argv=None) -> int:
     p.add_argument("--encrypt", action="store_true", help="threshold-encrypt contributions")
     p.add_argument("--coin", choices=["hash", "threshold"], default="hash")
     p.add_argument("--verify", action="store_true", help="verify crypto shares")
+    p.add_argument(
+        "--engine",
+        choices=["cpu", "tpu"],
+        default="cpu",
+        help="CryptoEngine backend for the consensus cores",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--drop", type=float, default=0.0, help="message drop rate")
     p.add_argument("--dup", type=float, default=0.0, help="message duplication rate")
@@ -51,6 +57,7 @@ def main(argv=None) -> int:
         encrypt=args.encrypt,
         coin_mode=args.coin,
         verify_shares=args.verify,
+        engine=args.engine,
         seed=args.seed,
         adversary=adversary,
     )

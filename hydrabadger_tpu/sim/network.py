@@ -22,6 +22,7 @@ from ..consensus.dynamic_honey_badger import DynamicHoneyBadger
 from ..consensus.queueing import QueueingHoneyBadger
 from ..consensus.types import NetworkInfo
 from ..crypto import threshold as th
+from ..crypto.engine import get_engine
 from .router import Router
 
 
@@ -39,6 +40,7 @@ class SimConfig:
     encrypt: bool = False
     coin_mode: str = "hash"  # "hash" | "threshold"
     verify_shares: bool = False
+    engine: str = "cpu"  # CryptoEngine: "cpu" | "tpu"
     # scheduling
     seed: int = 0
     shuffle: bool = True
@@ -106,6 +108,7 @@ class SimNetwork:
             cfg.n_nodes, cfg.seed
         )
         self.rng = random.Random(cfg.seed + 1)
+        engine = get_engine(cfg.engine)
         if cfg.protocol == "qhb":
             self.nodes: Dict = {
                 nid: QueueingHoneyBadger(
@@ -114,6 +117,7 @@ class SimNetwork:
                     encrypt=cfg.encrypt,
                     coin_mode=cfg.coin_mode,
                     verify_shares=cfg.verify_shares,
+                    engine=engine,
                 )
                 for nid in self.ids
             }
@@ -132,6 +136,7 @@ class SimNetwork:
                     verify_shares=cfg.verify_shares,
                     # per-node seed: DKG secrets must differ across nodes
                     rng=random.Random(cfg.seed * 1_000_003 + 2 + idx),
+                    engine=engine,
                 )
                 for idx, nid in enumerate(self.ids)
             }

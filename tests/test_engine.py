@@ -1,0 +1,100 @@
+"""CryptoEngine: the pluggable backend boundary (BASELINE.json north star).
+
+The protocol cores must behave identically under every engine — the
+engine only chooses *where* the crypto math runs (per-instance CPU vs
+batched device kernels), never *what* it computes.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from hydrabadger_tpu.crypto import threshold as th
+from hydrabadger_tpu.crypto.engine import (
+    CpuEngine,
+    TpuEngine,
+    get_engine,
+    register_engine,
+)
+
+
+def test_registry_and_default():
+    assert get_engine() is get_engine()  # singleton default
+    assert isinstance(get_engine(), CpuEngine)
+    assert get_engine("cpu").name == "cpu"
+    assert get_engine("tpu").name == "tpu"
+    assert isinstance(get_engine("tpu"), TpuEngine)
+    eng = CpuEngine()
+    assert get_engine(eng) is eng
+    with pytest.raises(ValueError):
+        get_engine("cuda")
+
+
+def test_custom_engine_registration():
+    class Traced(CpuEngine):
+        name = "traced"
+
+    register_engine("traced", Traced)
+    assert isinstance(get_engine("traced"), Traced)
+
+
+def test_rs_scalar_roundtrip_both_engines():
+    payload = bytes(range(64)) * 3
+    for eng in (get_engine("cpu"), get_engine("tpu")):
+        shards = eng.rs_encode_bytes(payload, 4, 2)
+        assert len(shards) == 6
+        slots = [None, shards[1], shards[2], shards[3], shards[4], None]
+        assert eng.rs_reconstruct_data(slots, 4, 2) == payload
+
+
+def test_rs_batch_cpu_tpu_bit_equal():
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (5, 4, 32)).astype(np.uint8)
+    cpu, tpu = get_engine("cpu"), get_engine("tpu")
+    enc_cpu = cpu.rs_encode_batch(data, 4, 2)
+    enc_tpu = tpu.rs_encode_batch(data, 4, 2)
+    assert np.array_equal(enc_cpu, enc_tpu)
+    rows = (1, 2, 4, 5)  # drop shards 0 and 3
+    surviving = enc_cpu[:, list(rows), :]
+    dec_cpu = cpu.rs_reconstruct_batch(surviving, rows, 4, 2)
+    dec_tpu = tpu.rs_reconstruct_batch(surviving, rows, 4, 2)
+    assert np.array_equal(dec_cpu, data)
+    assert np.array_equal(dec_tpu, data)
+
+
+def test_threshold_ops_through_engine():
+    rng = random.Random(1)
+    eng = get_engine("cpu")
+    sks = th.SecretKeySet.random(1, rng)
+    pk_set = sks.public_keys()
+    msg = b"engine boundary"
+    ct = eng.encrypt(pk_set.public_key(), msg, rng)
+    shares = {}
+    for i in range(3):
+        share = eng.decrypt_share(sks.secret_key_share(i), ct)
+        assert eng.verify_decryption_share(pk_set.public_key_share(i), share, ct)
+        shares[i] = share
+    assert eng.combine_decryption_shares(pk_set, shares, ct) == msg
+    sig_shares = {
+        i: eng.sign_share(sks.secret_key_share(i), msg) for i in range(2)
+    }
+    for i, s in sig_shares.items():
+        assert eng.verify_signature_share(pk_set, i, s, msg)
+    sig = eng.combine_signature_shares(pk_set, sig_shares)
+    assert eng.verify(pk_set.public_key(), sig, msg)
+    sk = th.SecretKey.random(rng)
+    assert eng.verify_batch(
+        [(sk.public_key(), eng.sign(sk, msg), msg)]
+    ) == [True]
+
+
+def test_sim_runs_on_tpu_engine():
+    """Protocol behavior is engine-independent: same batches, agreement."""
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    base = dict(n_nodes=4, epochs=3, seed=11)
+    m_cpu = SimNetwork(SimConfig(engine="cpu", **base)).run()
+    m_tpu = SimNetwork(SimConfig(engine="tpu", **base)).run()
+    assert m_cpu.agreement_ok and m_tpu.agreement_ok
+    assert m_cpu.epochs_done == m_tpu.epochs_done
+    assert m_cpu.txns_committed == m_tpu.txns_committed

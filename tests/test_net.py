@@ -136,3 +136,45 @@ async def test_late_joiner_becomes_observer_then_validator():
         assert ok, "joiner's contribution never committed"
     finally:
         await stop_cluster(nodes)
+
+@pytest.mark.asyncio
+async def test_user_key_gen_completes_across_nodes():
+    """Every node joins a peer-initiated ('user', uid) DKG instance and the
+    initiator's event queue yields ('complete', pk_set, share)."""
+    nodes = await start_cluster(3, BASE_PORT + 30)
+    try:
+        assert await wait_for(lambda: all(n.is_validator() for n in nodes))
+        queue = nodes[0].new_key_gen_instance()
+        event = await asyncio.wait_for(queue.get(), timeout=30)
+        assert event[0] == "complete", event
+        pk_set, share = event[1], event[2]
+        assert pk_set is not None and share is not None
+        # non-initiators spun up their own machines for the instance
+        owner = nodes[0].uid.bytes
+        assert await wait_for(
+            lambda: all(owner in n.user_key_gens for n in nodes[1:])
+        )
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_consensus_src_spoof_rejected():
+    """A frame whose claimed consensus source differs from the connection's
+    authenticated uid must be dropped (impersonation guard, peer.rs:158)."""
+    nodes = await start_cluster(2, BASE_PORT + 40, cfg=fast_config(keygen_peer_count=1))
+    try:
+        assert await wait_for(lambda: all(n.is_validator() for n in nodes))
+        victim = nodes[1]
+        spoofed_src = b"\x99" * 16  # not the sender's uid
+        peer = next(iter(victim.peers.established()))
+        before = len(victim.iom_queue)
+        victim._on_peer_msg(
+            peer, WireMessage("message", (spoofed_src, ("hb", 0, ("cs", 0, ("bc_ready", b"r"))))),
+            b"", b"",
+        )
+        # dropped: neither queued nor dispatched (dhb saw no new faults from
+        # an id that is not even a validator)
+        assert len(victim.iom_queue) == before
+    finally:
+        await stop_cluster(nodes)

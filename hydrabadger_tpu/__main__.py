@@ -1,0 +1,213 @@
+"""peer-node CLI: run one consensus node over TCP.
+
+Flag-for-flag parity with the reference binary (src/bin/peer_node.rs:21-78):
+
+    python -m hydrabadger_tpu -b 127.0.0.1:3000 \
+        -r 127.0.0.1:3001 -r 127.0.0.1:3002
+
+Environment: HYDRABADGER_LOG sets the log level/filters the way the
+reference's env_logger setup does (peer_node.rs:110-122) — e.g.
+``HYDRABADGER_LOG=info`` or ``HYDRABADGER_LOG=hydrabadger_tpu.net=debug``.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import random
+import sys
+from typing import List
+
+from .net.node import Config, Hydrabadger
+from .utils.ids import InAddr, OutAddr
+
+
+def _parse_addr(spec: str):
+    host, _, port = spec.rpartition(":")
+    if not host or not port.isdigit():
+        raise argparse.ArgumentTypeError(f"bad address {spec!r} (want host:port)")
+    return host, int(port)
+
+
+def setup_logging() -> None:
+    """HYDRABADGER_LOG: either a bare level or comma-separated
+    `module=level` filters (the reference's filter recipe, gdb-node:27)."""
+    spec = os.environ.get("HYDRABADGER_LOG", "info")
+    logging.basicConfig(
+        level=logging.WARNING,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    def resolve(name: str) -> int:
+        # env_logger accepts "trace"/"off"; map them rather than crash
+        aliases = {"TRACE": "DEBUG", "OFF": "CRITICAL", "WARN": "WARNING"}
+        name = aliases.get(name.upper(), name.upper())
+        level = logging.getLevelName(name)
+        return level if isinstance(level, int) else logging.INFO
+
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" in clause:
+            mod, _, level = clause.partition("=")
+            logging.getLogger(mod).setLevel(resolve(level))
+        else:
+            logging.getLogger().setLevel(resolve(clause))
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hydrabadger_tpu",
+        description="a hydrabadger consensus node (reference: peer_node.rs)",
+    )
+    p.add_argument(
+        "-b",
+        "--bind-address",
+        type=_parse_addr,
+        default=("127.0.0.1", 3010),
+        metavar="HOST:PORT",
+        help="the socket address to listen on (peer_node.rs:27-33)",
+    )
+    p.add_argument(
+        "-r",
+        "--remote-address",
+        type=_parse_addr,
+        action="append",
+        default=[],
+        metavar="HOST:PORT",
+        help="peer(s) to dial; repeatable (peer_node.rs:34-40)",
+    )
+    # declared-but-dead in the reference (peer_node.rs:41-45: parsed, never
+    # read); here it caps generated-contribution size for real
+    p.add_argument("--batch-size", type=int, default=150)
+    p.add_argument(
+        "--txn-gen-count",
+        type=int,
+        default=5,
+        help="transactions generated per interval (hydrabadger.rs:36)",
+    )
+    p.add_argument(
+        "--txn-gen-interval",
+        type=int,
+        default=5000,
+        metavar="MS",
+        help="generation interval in ms (hydrabadger.rs:38)",
+    )
+    p.add_argument(
+        "--txn-gen-bytes",
+        type=int,
+        default=2,
+        help="size of each random transaction (hydrabadger.rs:40)",
+    )
+    p.add_argument(
+        "--keygen-node-count",
+        type=int,
+        default=3,
+        metavar="N",
+        help="nodes required to start key generation; maps to "
+        "keygen_peer_count = N-1 (peer_node.rs:158-163)",
+    )
+    p.add_argument(
+        "--output-extra-delay",
+        type=int,
+        default=0,
+        metavar="MS",
+        help="extra delay after each batch output (hydrabadger.rs:44)",
+    )
+    p.add_argument(
+        "--start-epoch", type=int, default=0, help="era to start DHB at"
+    )
+    p.add_argument(
+        "--engine",
+        choices=["cpu", "tpu"],
+        default="cpu",
+        help="CryptoEngine backend (north star: engine off the Config)",
+    )
+    p.add_argument(
+        "--fast-crypto",
+        action="store_true",
+        help="development tier: hash coin, no threshold encryption, "
+        "no per-frame signatures",
+    )
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument(
+        "--mine",
+        action="store_true",
+        help="run the toy PoW blockchain demo and exit (peer_node.rs:81-92)",
+    )
+    return p
+
+
+def gen_txns_factory(seed=None):
+    rng = random.Random(seed)
+
+    def gen_txns(count: int, nbytes: int) -> List[bytes]:
+        return [
+            bytes(rng.getrandbits(8) for _ in range(max(1, nbytes)))
+            for _ in range(count)
+        ]
+
+    return gen_txns
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    setup_logging()
+    if args.mine:
+        from . import blockchain
+
+        chain = blockchain.mine(3)
+        for block in chain.traverse():
+            print(f"#{block.index} nonce={block.nonce} hash={block.hash}")
+        return 0
+
+    cfg = Config(
+        txn_gen_count=args.txn_gen_count,
+        txn_gen_interval_ms=args.txn_gen_interval,
+        txn_gen_bytes=args.txn_gen_bytes,
+        keygen_peer_count=max(1, args.keygen_node_count - 1),
+        output_extra_delay_ms=args.output_extra_delay,
+        start_epoch=args.start_epoch,
+        engine=args.engine,
+    )
+    if args.fast_crypto:
+        cfg.encrypt = False
+        cfg.coin_mode = "hash"
+        cfg.verify_shares = False
+        cfg.wire_sign = False
+
+    host, port = args.bind_address
+    node = Hydrabadger(InAddr(host, port), cfg, seed=args.seed)
+    remotes = [OutAddr(h, p) for h, p in args.remote_address]
+
+    async def run():
+        async def log_batches():
+            while True:
+                batch = await node.batch_queue.get()
+                print(
+                    f"epoch {batch.epoch}: "
+                    f"{len(batch.contributions)} contributions, "
+                    f"{sum(len(bytes(v)) for v in batch.contributions.values())}B",
+                    flush=True,
+                )
+
+        task = asyncio.create_task(log_batches())
+        gen = gen_txns_factory(args.seed)
+        try:
+            await node.run_node(
+                remotes, lambda c, b: gen(min(c, args.batch_size), b)
+            )
+        finally:
+            task.cancel()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

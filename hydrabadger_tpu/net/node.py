@@ -254,10 +254,25 @@ class Hydrabadger:
             self._drop_peer(peer)
 
     async def _connect_outgoing(self, remote: OutAddr) -> None:
-        try:
-            reader, writer = await asyncio.open_connection(remote.host, remote.port)
-        except OSError as e:
-            log.warning("connect to %s failed: %r", remote, e)
+        # dial with bounded backoff: peers launched simultaneously (the
+        # run-node script topology) race their listeners; the reference
+        # absorbs the same race with its wire retry queue (capped at 10
+        # attempts, handler.rs:660-670 / mod.rs:17)
+        reader = writer = None
+        for attempt in range(10):
+            try:
+                reader, writer = await asyncio.open_connection(
+                    remote.host, remote.port
+                )
+                break
+            except OSError as e:
+                log.warning(
+                    "connect to %s failed (attempt %d): %r", remote, attempt, e
+                )
+                if attempt < 9:
+                    await asyncio.sleep(min(0.2 * 2**attempt, 5.0))
+        if reader is None:
+            log.error("giving up dialling %s", remote)
             return
         stream = WireStream(
             reader, writer, self.secret_key, self.cfg.wire_sign

@@ -1,0 +1,23 @@
+# Shared by run-node / debug-node / profile-node / memprof-node.
+# Computes the reference's local test topology (run-node:19-25): node <id>
+# listens on port 3000+id and dials its 2 lower neighbors.  Honors
+# HYDRABADGER_FAST (default 1: hash coin, no threshold encryption, no
+# frame signatures — the full tier is pairing-bound in the pure-Python
+# BLS engine; set HYDRABADGER_FAST=0 for the full crypto tier).
+if [[ $# -lt 1 ]]; then
+    echo "usage: $0 <node-id> [extra peer-node args...]" >&2
+    exit 1
+fi
+ID=$1
+shift
+PORT=$((3000 + ID))
+REMOTES=()
+for ((i = ID - 2; i < ID; i++)); do
+    ((i >= 0)) && REMOTES+=(-r "127.0.0.1:$((3000 + i))")
+done
+EXTRA=()
+if [[ "${HYDRABADGER_FAST:-1}" == "1" ]]; then
+    EXTRA+=(--fast-crypto)
+fi
+NODE_ARGS=(-b "127.0.0.1:${PORT}" "${REMOTES[@]}" "${EXTRA[@]}" "$@")
+cd "$(dirname "${BASH_SOURCE[0]}")/.."

@@ -1,0 +1,41 @@
+"""Toy PoW blockchain — working version of the reference's only test
+(tests/blockchain_test.rs:1-14, which does not even compile)."""
+import pytest
+
+from hydrabadger_tpu.blockchain import (
+    DIFFICULTY_HEX_ZEROS,
+    Block,
+    Blockchain,
+    MiningError,
+    mine,
+)
+
+
+def test_genesis_is_mined():
+    g = Block.genesis()
+    assert g.index == 0
+    assert g.hash.startswith("0" * DIFFICULTY_HEX_ZEROS)
+    assert g.hash == g.calculate_hash()
+
+
+def test_chain_add_and_traverse():
+    chain = Blockchain()
+    chain.add_block(b"hello")
+    chain.add_block(b"world")
+    assert chain.height == 3
+    blocks = list(chain.traverse())  # newest -> oldest, validated
+    assert [b.index for b in blocks] == [2, 1, 0]
+    assert blocks[0].prev_hash == blocks[1].hash
+
+
+def test_tampering_detected():
+    chain = Blockchain()
+    chain.add_block(b"payload")
+    chain.blocks[1].data = b"forged"
+    with pytest.raises(MiningError):
+        list(chain.traverse())
+
+
+def test_mine_demo():
+    chain = mine(2)
+    assert chain.height == 3

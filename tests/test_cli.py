@@ -1,0 +1,55 @@
+"""peer-node CLI: flag parity with peer_node.rs:21-78."""
+import pytest
+
+from hydrabadger_tpu.__main__ import gen_txns_factory, make_parser
+
+
+def test_reference_flags_accepted():
+    p = make_parser()
+    args = p.parse_args(
+        [
+            "-b", "127.0.0.1:3000",
+            "-r", "127.0.0.1:3001",
+            "-r", "127.0.0.1:3002",
+            "--batch-size", "50",
+            "--txn-gen-count", "3",
+            "--txn-gen-interval", "100",
+            "--txn-gen-bytes", "4",
+            "--keygen-node-count", "4",
+            "--output-extra-delay", "10",
+            "--engine", "tpu",
+        ]
+    )
+    assert args.bind_address == ("127.0.0.1", 3000)
+    assert args.remote_address == [("127.0.0.1", 3001), ("127.0.0.1", 3002)]
+    assert args.keygen_node_count == 4
+    assert args.engine == "tpu"
+
+
+def test_defaults_match_reference():
+    """hydrabadger.rs:35-45 compiled defaults."""
+    args = make_parser().parse_args([])
+    assert args.txn_gen_count == 5
+    assert args.txn_gen_interval == 5000
+    assert args.txn_gen_bytes == 2
+    assert args.output_extra_delay == 0
+
+
+def test_bad_address_rejected():
+    with pytest.raises(SystemExit):
+        make_parser().parse_args(["-b", "nonsense"])
+
+
+def test_txn_generator():
+    gen = gen_txns_factory(seed=1)
+    txns = gen(5, 2)
+    assert len(txns) == 5
+    assert all(len(t) == 2 for t in txns)
+
+
+def test_mine_flag(capsys):
+    from hydrabadger_tpu.__main__ import main
+
+    assert main(["--mine"]) == 0
+    out = capsys.readouterr().out
+    assert "#0" in out and "nonce=" in out

@@ -131,6 +131,26 @@ class CpuEngine:
     ) -> bytes:
         return pk_set.decrypt(shares, ct)
 
+    def decrypt_share_batch(
+        self,
+        items: Sequence[Tuple[th.SecretKeyShare, th.Ciphertext]],
+    ) -> List[th.DecryptionShare]:
+        """Batched share generation U*sk_i across (instance, node) pairs.
+
+        The CPU baseline is the per-node loop every validator runs inside
+        hbbft::threshold_decrypt (reference state.rs:487); the TPU engine
+        lifts the whole batch into one scalar-mul kernel."""
+        return [sk.decrypt_share(ct) for sk, ct in items]
+
+    def combine_decryption_shares_batch(
+        self,
+        jobs: Sequence[
+            Tuple[th.PublicKeySet, Mapping[int, th.DecryptionShare], th.Ciphertext]
+        ],
+    ) -> List[bytes]:
+        """Batched Lagrange combine-in-the-exponent + KDF unwrap."""
+        return [pk_set.decrypt(shares, ct) for pk_set, shares, ct in jobs]
+
     # -- threshold signatures (hbbft::threshold_sign / the common coin) -----
 
     def sign_share(
@@ -184,6 +204,56 @@ class TpuEngine(CpuEngine):
             surviving, tuple(int(r) for r in rows), data_shards, parity_shards
         )
         return np.asarray(out)
+
+    def decrypt_share_batch(
+        self,
+        items: Sequence[Tuple[th.SecretKeyShare, th.Ciphertext]],
+    ) -> List[th.DecryptionShare]:
+        if not items:
+            return []
+        from ..ops import bls_jax
+
+        points = bls_jax.g1_scalar_mul_batch(
+            [ct.u for _, ct in items], [sk.scalar for sk, _ in items]
+        )
+        return [th.DecryptionShare(p) for p in points]
+
+    def combine_decryption_shares_batch(
+        self,
+        jobs: Sequence[
+            Tuple[th.PublicKeySet, Mapping[int, th.DecryptionShare], th.Ciphertext]
+        ],
+    ) -> List[bytes]:
+        """One weighted-sum kernel launch per quorum size S.
+
+        Jobs are grouped by S because the combine tensor is [B, S, ...];
+        in a steady-state sim every instance shares the same S, so this
+        is one launch."""
+        if not jobs:
+            return []
+        from ..ops import bls_jax
+
+        by_size: Dict[int, List[int]] = {}
+        prepared = []
+        for idx, (pk_set, shares, ct) in enumerate(jobs):
+            if len(shares) <= pk_set.threshold:
+                raise ValueError(
+                    f"need {pk_set.threshold + 1} shares, got {len(shares)}"
+                )
+            ids = sorted(shares)[: pk_set.threshold + 1]
+            xs = [i + 1 for i in ids]
+            lam = th.lagrange_coeffs_at_zero(xs)
+            pts = [shares[i].point for i in ids]
+            prepared.append((pts, lam, ct))
+            by_size.setdefault(len(ids), []).append(idx)
+        out: List[Optional[bytes]] = [None] * len(jobs)
+        for size, idxs in by_size.items():
+            combined = bls_jax.g1_weighted_sum_batch(
+                [prepared[i][0] for i in idxs], [prepared[i][1] for i in idxs]
+            )
+            for i, g in zip(idxs, combined):
+                out[i] = th.unwrap_ciphertext(g, prepared[i][2])
+        return out  # type: ignore[return-value]
 
 _REGISTRY: Dict[str, type] = {"cpu": CpuEngine, "tpu": TpuEngine}
 _DEFAULT: Optional[CpuEngine] = None

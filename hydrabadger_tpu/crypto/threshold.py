@@ -114,6 +114,14 @@ def _kdf(point, n_bytes: int, domain: bytes = b"HBTPU-KDF") -> bytes:
     return bls._expand_message(g1_to_bytes(point), domain, n_bytes)
 
 
+def unwrap_ciphertext(g, ct: "Ciphertext") -> bytes:
+    """Recover the plaintext from the combined share point g = U*sk.
+
+    Single definition of the KDF-XOR unwrap so the CPU path
+    (PublicKeySet.decrypt) and the batched TPU engine can never drift."""
+    return bytes(a ^ b for a, b in zip(ct.v, _kdf(g, len(ct.v))))
+
+
 # ---------------------------------------------------------------------------
 # Keys and signatures
 # ---------------------------------------------------------------------------
@@ -363,8 +371,7 @@ class PublicKeySet:
                 f"need {self.threshold + 1} shares, got {len(shares)}"
             )
         pts = {i + 1: s.point for i, s in shares.items()}
-        g = interpolate_g_at_zero(pts)
-        return bytes(a ^ b for a, b in zip(ct.v, _kdf(g, len(ct.v))))
+        return unwrap_ciphertext(interpolate_g_at_zero(pts), ct)
 
     def to_bytes(self) -> bytes:
         return b"".join(g1_to_bytes(c) for c in self.commitment)

@@ -1,0 +1,431 @@
+"""Batched BLS12-381 G1 arithmetic on TPU — the ThresholdDecrypt hot kernel.
+
+SURVEY.md §7 ranks "BLS12-381 on TPU" the #1 hard part, and BASELINE.json
+config 4 is its benchmark: 64-node sim, 1024 concurrent epochs, batched
+threshold-decryption share generation + Lagrange combine.  In the
+reference every node computes `U * sk_i` and the combiner interpolates
+in the exponent one share at a time inside hbbft::threshold_decrypt
+(reached via dhb.handle_message, /root/reference/src/hydrabadger/state.rs:487);
+here those group operations run for *all* (instances x nodes x epochs)
+at once as one XLA program.
+
+Design (TPU-first, not a bignum port):
+
+  - A field element is a little-endian vector of 32 x 12-bit limbs held
+    in an int32 tensor `[..., 32]`.  12-bit limbs are chosen so a full
+    schoolbook product term `sum_i a_i * b_{k-i}` (<= 32 terms of 24
+    bits) stays under 2^31 — exact in int32, no 64-bit integers, which
+    TPUs lack natively.
+  - Multiplication is Montgomery (R = 2^384): one full convolution, a
+    low convolution by -p^-1 mod R, one more convolution by p, and
+    carry-propagation scans.  Convolutions are expressed as a static
+    gather + einsum so they vectorise over any batch shape; carries are
+    `lax.scan`s over the 32/64 limb axis (vector ops over the batch).
+  - G1 points are Jacobian (X, Y, Z), Z == 0 at infinity, coordinates in
+    the Montgomery domain, stacked as `[..., 3, 32]`.  Add/double use
+    branch-free formulas with `where` masks for the inf/equal cases, so
+    they map cleanly onto SIMD lanes — no data-dependent control flow
+    under jit (the XLA compilation-model constraint).
+  - Scalar multiplication is a 255-step `lax.scan` of double-and-add
+    over MSB-first bit columns; the whole batch shares the loop, each
+    lane selects with its own bit.
+
+The pure-Python `crypto/bls12_381.py` engine is the bit-exactness oracle
+(tests/test_bls_jax.py); `crypto/engine.TpuEngine` routes the batch
+entry points here.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bls12_381 as bls
+from ..crypto.bls12_381 import FQ, P
+
+# ---------------------------------------------------------------------------
+# Limb layout and Montgomery constants (host numpy; become jit constants)
+# ---------------------------------------------------------------------------
+
+LIMB_BITS = 12
+N_LIMBS = 32  # 384 bits >= 381-bit p
+LIMB_MASK = (1 << LIMB_BITS) - 1
+R_MONT = 1 << (LIMB_BITS * N_LIMBS)  # 2^384
+
+
+def int_to_limbs(n: int) -> np.ndarray:
+    """Python int -> [32] int32 little-endian 12-bit limbs."""
+    if not 0 <= n < R_MONT:
+        raise ValueError("out of limb range")
+    return np.array(
+        [(n >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS)],
+        dtype=np.int32,
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    limbs = np.asarray(limbs)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(limbs))
+
+
+def limbs_to_ints_batch(arr) -> list[int]:
+    """[B, 32] canonical limbs -> B Python ints, vectorised."""
+    arr = np.asarray(arr)
+    bits = ((arr[..., None] >> np.arange(LIMB_BITS)) & 1).astype(np.uint8)
+    raw = np.packbits(
+        bits.reshape(arr.shape[0], N_LIMBS * LIMB_BITS), axis=1, bitorder="little"
+    )
+    return [int.from_bytes(r.tobytes(), "little") for r in raw]
+
+
+P_LIMBS = int_to_limbs(P)
+PINV = (-pow(P, -1, R_MONT)) % R_MONT  # p * PINV == -1 mod R
+PINV_LIMBS = int_to_limbs(PINV)
+R2_LIMBS = int_to_limbs(R_MONT * R_MONT % P)  # to-Montgomery factor
+ONE_LIMBS = int_to_limbs(1)
+ONE_MONT = int_to_limbs(R_MONT % P)
+
+# Static gather indices for convolution-as-einsum.
+# full product:  c[k] = sum_i a[i] * b[k-i],  k in [0, 63)
+_IDX_FULL = np.arange(2 * N_LIMBS - 1)[:, None] - np.arange(N_LIMBS)[None, :]
+_MASK_FULL = ((_IDX_FULL >= 0) & (_IDX_FULL < N_LIMBS)).astype(np.int32)
+_IDX_FULL_C = np.clip(_IDX_FULL, 0, N_LIMBS - 1)
+# low product (mod R): only k in [0, 32)
+_IDX_LOW = np.arange(N_LIMBS)[:, None] - np.arange(N_LIMBS)[None, :]
+_MASK_LOW = (_IDX_LOW >= 0).astype(np.int32)
+_IDX_LOW_C = np.clip(_IDX_LOW, 0, N_LIMBS - 1)
+
+
+# ---------------------------------------------------------------------------
+# Limb-vector primitives (everything batched over leading axes)
+# ---------------------------------------------------------------------------
+
+
+def _conv(a: jax.Array, b: jax.Array, idx, mask) -> jax.Array:
+    """Schoolbook product terms c[k] = sum_i a[i]*b[k-i] via gather+einsum.
+
+    Max term value: 32 * (2^12-1)^2 < 2^29 — exact in int32.
+    """
+    b_exp = jnp.take(b, jnp.asarray(idx), axis=-1) * jnp.asarray(mask)
+    return jnp.einsum("...i,...ki->...k", a, b_exp)
+
+
+def _carry(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Propagate carries -> canonical 12-bit limbs + carry-out.
+
+    Values must stay < 2^31 - 2^19 at every step (they do: conv terms
+    are < 2^29, carries < 2^19).
+    """
+
+    def step(c, xi):
+        t = xi + c
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    carry, limbs = jax.lax.scan(
+        step, jnp.zeros_like(x[..., 0]), jnp.moveaxis(x, -1, 0)
+    )
+    return jnp.moveaxis(limbs, 0, -1), carry
+
+
+def _sub_limbs(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(a - b) limbwise with borrow propagation -> (diff, borrow_out)."""
+
+    def step(brw, ab):
+        ai, bi = ab
+        t = ai - bi - brw
+        b2 = (t < 0).astype(jnp.int32)
+        return b2, t + (b2 << LIMB_BITS)
+
+    borrow, limbs = jax.lax.scan(
+        step,
+        jnp.zeros_like(a[..., 0]),
+        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)),
+    )
+    return jnp.moveaxis(limbs, 0, -1), borrow
+
+
+def _cond_sub_p(r: jax.Array) -> jax.Array:
+    """r in [0, 2p) -> r mod p."""
+    d, borrow = _sub_limbs(r, jnp.asarray(P_LIMBS))
+    return jnp.where((borrow == 0)[..., None], d, r)
+
+
+def fq_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Montgomery product: a * b * R^-1 mod p (inputs/outputs in [0, p))."""
+    c = _conv(a, b, _IDX_FULL_C, _MASK_FULL)  # [..., 63]
+    c, cc = _carry(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)  # [..., 64]
+    # m = (c mod R) * (-p^-1) mod R
+    m = _conv(cn[..., :N_LIMBS], jnp.asarray(PINV_LIMBS), _IDX_LOW_C, _MASK_LOW)
+    m, _ = _carry(m)
+    mp = _conv(m, jnp.asarray(P_LIMBS), _IDX_FULL_C, _MASK_FULL)
+    t = cn + jnp.pad(mp, [(0, 0)] * (mp.ndim - 1) + [(0, 1)])
+    t, _ = _carry(t)  # (ab + mp) < 2^767: carry-out of limb 63 is 0
+    return _cond_sub_p(t[..., N_LIMBS:])  # exact division by R = limb shift
+
+
+def fq_add(a: jax.Array, b: jax.Array) -> jax.Array:
+    s, _ = _carry(a + b)  # < 2p < 2^382: no carry-out
+    return _cond_sub_p(s)
+
+
+def fq_sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    d, borrow = _sub_limbs(a, b)
+    dp, _ = _carry(d + jnp.asarray(P_LIMBS))
+    return jnp.where((borrow == 1)[..., None], dp, d)
+
+
+def fq_is_zero(a: jax.Array) -> jax.Array:
+    return jnp.all(a == 0, axis=-1)
+
+
+def to_mont(a: jax.Array) -> jax.Array:
+    return fq_mul(a, jnp.asarray(R2_LIMBS))
+
+
+def from_mont(a: jax.Array) -> jax.Array:
+    return fq_mul(a, jnp.asarray(ONE_LIMBS))
+
+
+# ---------------------------------------------------------------------------
+# Jacobian G1 (y^2 = x^3 + 4): [..., 3, 32] int32 in Montgomery domain
+# ---------------------------------------------------------------------------
+
+
+def jac_infinity(batch_shape=()) -> jax.Array:
+    one = jnp.asarray(ONE_MONT)
+    pt = jnp.stack([one, one, jnp.zeros_like(one)])
+    return jnp.broadcast_to(pt, tuple(batch_shape) + (3, N_LIMBS))
+
+
+def jac_is_inf(pt: jax.Array) -> jax.Array:
+    return fq_is_zero(pt[..., 2, :])
+
+
+def jac_double(pt: jax.Array) -> jax.Array:
+    """2P, a=0 Jacobian doubling (handles inf via Z3 = 2YZ = 0)."""
+    x, y, z = pt[..., 0, :], pt[..., 1, :], pt[..., 2, :]
+    a = fq_mul(x, x)  # X^2
+    b = fq_mul(y, y)  # Y^2
+    c = fq_mul(b, b)  # Y^4
+    t = fq_add(x, b)
+    d = fq_sub(fq_sub(fq_mul(t, t), a), c)
+    d = fq_add(d, d)  # 2((X+B)^2 - A - C)
+    e = fq_add(fq_add(a, a), a)  # 3X^2
+    f = fq_mul(e, e)
+    x3 = fq_sub(f, fq_add(d, d))
+    c8 = fq_add(c, c)
+    c8 = fq_add(c8, c8)
+    c8 = fq_add(c8, c8)
+    y3 = fq_sub(fq_mul(e, fq_sub(d, x3)), c8)
+    yz = fq_mul(y, z)
+    z3 = fq_add(yz, yz)
+    return jnp.stack([x3, y3, z3], axis=-2)
+
+
+def jac_add(p1: jax.Array, p2: jax.Array) -> jax.Array:
+    """P1 + P2, branch-free: inf and P1==P2 cases resolved with masks."""
+    x1, y1, z1 = p1[..., 0, :], p1[..., 1, :], p1[..., 2, :]
+    x2, y2, z2 = p2[..., 0, :], p2[..., 1, :], p2[..., 2, :]
+    z1z1 = fq_mul(z1, z1)
+    z2z2 = fq_mul(z2, z2)
+    u1 = fq_mul(x1, z2z2)
+    u2 = fq_mul(x2, z1z1)
+    s1 = fq_mul(fq_mul(y1, z2), z2z2)
+    s2 = fq_mul(fq_mul(y2, z1), z1z1)
+    h = fq_sub(u2, u1)
+    r = fq_sub(s2, s1)
+    hh = fq_mul(h, h)
+    hhh = fq_mul(h, hh)
+    v = fq_mul(u1, hh)
+    rr = fq_mul(r, r)
+    x3 = fq_sub(fq_sub(rr, hhh), fq_add(v, v))
+    y3 = fq_sub(fq_mul(r, fq_sub(v, x3)), fq_mul(s1, hhh))
+    z3 = fq_mul(fq_mul(z1, z2), h)
+    general = jnp.stack([x3, y3, z3], axis=-2)
+
+    inf1 = jac_is_inf(p1)[..., None, None]
+    inf2 = jac_is_inf(p2)[..., None, None]
+    h_zero = fq_is_zero(h)[..., None, None]
+    r_zero = fq_is_zero(r)[..., None, None]
+
+    res = jnp.where(h_zero & r_zero, jac_double(p1), general)
+    res = jnp.where(inf2, p1, res)
+    res = jnp.where(inf1, p2, res)
+    return res
+
+
+def scalars_to_bits(scalars: Sequence[int], n_bits: int = 255) -> np.ndarray:
+    """Python ints -> [B, n_bits] int32, MSB first (scan order).
+
+    Vectorised via big-endian byte expansion + unpackbits so 64k-scalar
+    benches don't pay a Python bit loop."""
+    n_bytes = (n_bits + 7) // 8
+    raw = np.frombuffer(
+        b"".join(int(s).to_bytes(n_bytes, "big") for s in scalars), dtype=np.uint8
+    ).reshape(len(scalars), n_bytes)
+    bits = np.unpackbits(raw, axis=1)[:, -n_bits:]
+    return bits.astype(np.int32)
+
+
+@jax.jit
+def jac_scalar_mul(points: jax.Array, bits: jax.Array) -> jax.Array:
+    """[..., 3, 32] points x [..., n_bits] MSB-first bits -> [..., 3, 32].
+
+    One shared 255-step double-and-add scan; each batch lane selects the
+    add with its own bit — the XLA-friendly shape of the per-share
+    `U * sk_i` loop.
+    """
+    acc0 = jac_infinity(points.shape[:-2])
+
+    def step(acc, bit_col):
+        acc = jac_double(acc)
+        added = jac_add(acc, points)
+        acc = jnp.where(bit_col[..., None, None] != 0, added, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, acc0, jnp.moveaxis(bits, -1, 0))
+    return acc
+
+
+@jax.jit
+def jac_weighted_sum(points: jax.Array, bits: jax.Array) -> jax.Array:
+    """sum_s coeff[s] * P[s] per batch row.
+
+    points: [..., S, 3, 32], bits: [..., S, 255] -> [..., 3, 32].
+    The Lagrange-combine-in-the-exponent kernel: every instance's share
+    set reduces in lockstep.
+    """
+    terms = jac_scalar_mul(points, bits)  # [..., S, 3, 32]
+    s = terms.shape[-3]
+    # S is static (the share-quorum size): unroll the reduction tree so
+    # every level is one batched jac_add over [..., S/2] lanes.
+    cols = [terms[..., i, :, :] for i in range(s)]
+    while len(cols) > 1:
+        nxt = []
+        for i in range(0, len(cols) - 1, 2):
+            nxt.append(jac_add(cols[i], cols[i + 1]))
+        if len(cols) % 2:
+            nxt.append(cols[-1])
+        cols = nxt
+    return cols[0]
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (CPU <-> limb tensors)
+# ---------------------------------------------------------------------------
+
+
+def ints_to_limbs_batch(ns: Sequence[int]) -> np.ndarray:
+    """Python ints (< 2^384) -> [B, 32] int32 limbs, vectorised."""
+    raw = np.frombuffer(
+        b"".join(int(n).to_bytes(48, "little") for n in ns), dtype=np.uint8
+    ).reshape(len(ns), 48)
+    bits = np.unpackbits(raw, axis=1, bitorder="little")  # [B, 384]
+    w = (1 << np.arange(LIMB_BITS)).astype(np.int32)
+    return bits.reshape(len(ns), N_LIMBS, LIMB_BITS).astype(np.int32) @ w
+
+
+def _batch_inverse(vals: Sequence[int]) -> list[int]:
+    """Montgomery's trick: len(vals) inverses for one pow(-1)."""
+    prefix = [1]
+    for v in vals:
+        prefix.append(prefix[-1] * v % P)
+    inv_all = pow(prefix[-1], -1, P)
+    out = [0] * len(vals)
+    for i in range(len(vals) - 1, -1, -1):
+        out[i] = prefix[i] * inv_all % P
+        inv_all = inv_all * vals[i] % P
+    return out
+
+
+def points_to_limbs(pts: Sequence) -> np.ndarray:
+    """CPU projective points (crypto/bls12_381 tuples) -> [B, 3, 32]
+    Montgomery Jacobian limbs (normalised to Z = 1; infinity -> Z = 0).
+
+    One batched inversion + vectorised limb expansion — cheap enough to
+    feed 64k-share bench batches from host objects."""
+    rp = R_MONT % P
+    zs = [int(pt[2].n) for pt in pts]
+    invs = iter(_batch_inverse([z for z in zs if z]))
+    xs, ys, zouts = [], [], []
+    for pt, z in zip(pts, zs):
+        if z == 0:
+            xs.append(rp)
+            ys.append(rp)
+            zouts.append(0)
+            continue
+        zi = next(invs)
+        xs.append(pt[0].n * zi % P * rp % P)
+        ys.append(pt[1].n * zi % P * rp % P)
+        zouts.append(rp)
+    limbs = ints_to_limbs_batch(xs + ys + zouts).reshape(3, len(pts), N_LIMBS)
+    return np.ascontiguousarray(np.moveaxis(limbs, 0, 1))
+
+
+def point_to_limbs(pt) -> np.ndarray:
+    return points_to_limbs([pt])[0]
+
+
+def limbs_to_points(arr) -> list:
+    """[..., 3, 32] Montgomery Jacobian -> flat list of CPU projective points.
+
+    Batch inversion (Montgomery's trick) keeps this O(1) modular inverses
+    per call instead of one per point.
+    """
+    arr = np.asarray(jax.device_get(from_mont(jnp.asarray(arr))))
+    flat = arr.reshape(-1, 3, N_LIMBS)
+    xs = limbs_to_ints_batch(flat[:, 0])
+    ys = limbs_to_ints_batch(flat[:, 1])
+    zs = limbs_to_ints_batch(flat[:, 2])
+    invs = iter(_batch_inverse([z for z in zs if z]))
+    out = []
+    for x, y, z in zip(xs, ys, zs):
+        if z == 0:
+            out.append(bls.infinity(FQ))
+            continue
+        zi = next(invs)
+        zi2 = zi * zi % P
+        out.append((FQ(x * zi2 % P), FQ(y * zi2 % P * zi % P), FQ(1)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched threshold-crypto entry points (used by crypto.engine.TpuEngine)
+# ---------------------------------------------------------------------------
+
+
+def g1_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
+    """Batched U*sk over G1: len(points) == len(scalars) CPU points in,
+    CPU points out.  This is decrypt-share generation for a whole batch
+    of (instance, node) pairs at once."""
+    pts = jnp.asarray(points_to_limbs(points))
+    bits = jnp.asarray(scalars_to_bits([s % bls.R for s in scalars]))
+    return limbs_to_points(jac_scalar_mul(pts, bits))
+
+
+def g1_weighted_sum_batch(
+    points_batch: Sequence[Sequence], coeffs_batch: Sequence[Sequence[int]]
+) -> list:
+    """[B][S] points x [B][S] Fr coeffs -> B combined points.
+
+    Lagrange interpolation in the exponent for B instances at once —
+    the combine step of batched ThresholdDecrypt / ThresholdSign(G1).
+    """
+    b = len(points_batch)
+    if b == 0:
+        return []
+    s = len(points_batch[0])
+    pts = np.stack(
+        [points_to_limbs(row) for row in points_batch]
+    )  # [B, S, 3, 32]
+    bits = np.stack(
+        [
+            scalars_to_bits([c % bls.R for c in row])
+            for row in coeffs_batch
+        ]
+    )  # [B, S, 255]
+    assert pts.shape[:2] == (b, s) and bits.shape[:2] == (b, s)
+    return limbs_to_points(jac_weighted_sum(jnp.asarray(pts), jnp.asarray(bits)))

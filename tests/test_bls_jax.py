@@ -1,0 +1,134 @@
+"""Batched BLS12-381 TPU kernels vs the pure-Python CPU oracle.
+
+Bit-exactness contract (SURVEY.md §7 hard part 1): every limb-tensor
+result must equal the crypto/bls12_381.py reference — same field, same
+group, same bytes out of the threshold-decrypt pipeline.
+"""
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from hydrabadger_tpu.crypto import bls12_381 as bls
+from hydrabadger_tpu.crypto import threshold as th
+from hydrabadger_tpu.crypto.engine import CpuEngine, TpuEngine
+from hydrabadger_tpu.ops import bls_jax as bj
+
+
+def _rand_fq(rng):
+    return rng.getrandbits(384) % bls.P
+
+
+def test_limb_codec_roundtrip():
+    rng = random.Random(7)
+    for _ in range(10):
+        n = rng.getrandbits(384)
+        assert bj.limbs_to_int(bj.int_to_limbs(n % bj.R_MONT)) == n % bj.R_MONT
+    assert bj.limbs_to_int(bj.int_to_limbs(0)) == 0
+    assert bj.limbs_to_int(bj.int_to_limbs(bls.P)) == bls.P
+
+
+def test_fq_arithmetic_matches_python():
+    rng = random.Random(11)
+    avals = [_rand_fq(rng) for _ in range(4)] + [0, bls.P - 1]
+    bvals = [_rand_fq(rng) for _ in range(4)] + [bls.P - 1, bls.P - 1]
+    a = jnp.asarray(np.stack([bj.int_to_limbs(v) for v in avals]))
+    b = jnp.asarray(np.stack([bj.int_to_limbs(v) for v in bvals]))
+    prod = bj.from_mont(bj.fq_mul(bj.to_mont(a), bj.to_mont(b)))
+    s = bj.fq_add(a, b)
+    d = bj.fq_sub(a, b)
+    for i, (x, y) in enumerate(zip(avals, bvals)):
+        assert bj.limbs_to_int(np.asarray(prod)[i]) == x * y % bls.P
+        assert bj.limbs_to_int(np.asarray(s)[i]) == (x + y) % bls.P
+        assert bj.limbs_to_int(np.asarray(d)[i]) == (x - y) % bls.P
+
+
+def test_jac_double_add_match_reference():
+    rng = random.Random(13)
+    cpu_pts = [bls.multiply(bls.G1, rng.getrandbits(120) + 1) for _ in range(3)]
+    pts = jnp.asarray(bj.points_to_limbs(cpu_pts))
+    doubled = bj.limbs_to_points(bj.jac_double(pts))
+    for got, p in zip(doubled, cpu_pts):
+        assert bls.eq(got, bls.double(p))
+    other = cpu_pts[1:] + cpu_pts[:1]
+    added = bj.limbs_to_points(bj.jac_add(pts, jnp.asarray(bj.points_to_limbs(other))))
+    for got, p, q in zip(added, cpu_pts, other):
+        assert bls.eq(got, bls.add(p, q))
+    # equal-points path must fall through to doubling
+    same = bj.limbs_to_points(bj.jac_add(pts, pts))
+    for got, p in zip(same, cpu_pts):
+        assert bls.eq(got, bls.double(p))
+
+
+def test_scalar_mul_batch_including_edges():
+    rng = random.Random(17)
+    ks = [0, 1, 2, bls.R - 1, rng.getrandbits(254), rng.getrandbits(64)]
+    pts = [bls.multiply(bls.G1, rng.getrandbits(100) + 1) for _ in ks]
+    out = bj.g1_scalar_mul_batch(pts, ks)
+    for got, p, k in zip(out, pts, ks):
+        assert bls.eq(got, bls.multiply(p, k))
+    # infinity in, infinity out
+    (g,) = bj.g1_scalar_mul_batch([bls.infinity(bls.FQ)], [12345])
+    assert bls.is_inf(g)
+
+
+def test_weighted_sum_is_lagrange_combine():
+    rng = random.Random(19)
+    pts_b, coeff_b, expect = [], [], []
+    for _ in range(2):
+        pts = [bls.multiply(bls.G1, rng.getrandbits(80) + 1) for _ in range(3)]
+        xs = [1, 2, 3]
+        lam = th.lagrange_coeffs_at_zero(xs)
+        pts_b.append(pts)
+        coeff_b.append(lam)
+        expect.append(th.interpolate_g_at_zero(dict(zip(xs, pts))))
+    got = bj.g1_weighted_sum_batch(pts_b, coeff_b)
+    for g, e in zip(got, expect):
+        assert bls.eq(g, e)
+    # P + (-P) cancels to infinity inside the reduction tree
+    p = bls.multiply(bls.G1, 7)
+    (g,) = bj.g1_weighted_sum_batch([[p, p]], [[1, bls.R - 1]])
+    assert bls.is_inf(g)
+
+
+def test_engine_threshold_decrypt_parity():
+    """TpuEngine batch path == CpuEngine loop path, bytes-for-bytes."""
+    rng = random.Random(23)
+    t = 1
+    sk_set = th.SecretKeySet.random(t, rng)
+    pk_set = sk_set.public_keys()
+    shares = [sk_set.secret_key_share(i) for i in range(3)]
+    msgs = [b"batch-epoch-%d" % i for i in range(2)]
+    cts = [pk_set.public_key().encrypt(m, rng) for m in msgs]
+
+    cpu, tpu = CpuEngine(), TpuEngine()
+    items = [(shares[i], ct) for ct in cts for i in range(t + 1)]
+    dec_cpu = cpu.decrypt_share_batch(items)
+    dec_tpu = tpu.decrypt_share_batch(items)
+    for a, b in zip(dec_cpu, dec_tpu):
+        assert bls.eq(a.point, b.point)
+
+    jobs = []
+    k = 0
+    for ct in cts:
+        share_map = {}
+        for i in range(t + 1):
+            share_map[i] = dec_tpu[k]
+            k += 1
+        jobs.append((pk_set, share_map, ct))
+    out_tpu = tpu.combine_decryption_shares_batch(jobs)
+    out_cpu = cpu.combine_decryption_shares_batch(jobs)
+    assert out_tpu == out_cpu == msgs
+
+
+def test_combine_rejects_below_threshold():
+    rng = random.Random(29)
+    sk_set = th.SecretKeySet.random(1, rng)
+    pk_set = sk_set.public_keys()
+    ct = pk_set.public_key().encrypt(b"xx", rng)
+    share = sk_set.secret_key_share(0).decrypt_share(ct)
+    with pytest.raises(ValueError):
+        TpuEngine().combine_decryption_shares_batch([(pk_set, {0: share}, ct)])

@@ -262,6 +262,9 @@ def scalars_to_bits(scalars: Sequence[int], n_bits: int = 255) -> np.ndarray:
 
     Vectorised via big-endian byte expansion + unpackbits so 64k-scalar
     benches don't pay a Python bit loop."""
+    for s in scalars:
+        if not 0 <= int(s) < (1 << n_bits):
+            raise ValueError(f"scalar out of range [0, 2^{n_bits}): {s}")
     n_bytes = (n_bits + 7) // 8
     raw = np.frombuffer(
         b"".join(int(s).to_bytes(n_bytes, "big") for s in scalars), dtype=np.uint8

@@ -9,9 +9,18 @@ column dimension, so one MXU pass encodes thousands of proposals:
     reconstruct: [B, k, L] surviving shards (same survivor pattern
                  across the batch) -> [B, k, L] data rows
 
-Bit-equal to the CPU reference (tests/test_ops_gf.py) — a hard protocol
-requirement: every node must derive identical shards regardless of
-engine (SURVEY.md §7 hard part 4).
+Two device paths, both bit-equal to the CPU reference
+(tests/test_ops_gf.py) — a hard protocol requirement: every node must
+derive identical shards regardless of engine (SURVEY.md §7 hard
+part 4):
+
+  - XLA bit-matmul (gf256_jax._bits_matmul): default off-TPU.
+  - Fused Pallas kernel (gf256_jax._gf_matmul_pallas): default on TPU;
+    keeps the [8m, tile] accumulator in VMEM instead of round-tripping
+    ~16 bytes of int32 per output byte through HBM (~5x at large
+    batch, measured on v5e).
+
+`use_pallas=None` auto-selects by backend.
 """
 from __future__ import annotations
 
@@ -27,55 +36,92 @@ from . import gf256_jax
 
 
 @lru_cache(maxsize=256)
+def _parity_mats(data_shards: int, parity_shards: int):
+    """(abits f32 [8p, 8k], pack f32 [p, 8p]) for the pallas path."""
+    mat = np.asarray(encode_matrix(data_shards, parity_shards))[data_shards:]
+    return (
+        gf256_jax.bit_matrix(mat).astype(np.float32),
+        gf256_jax._pack_matrix(parity_shards),
+    )
+
+
+@lru_cache(maxsize=256)
 def _parity_bits(data_shards: int, parity_shards: int):
     mat = np.asarray(encode_matrix(data_shards, parity_shards))[data_shards:]
     return gf256_jax.bit_matrix(mat)
 
 
 @lru_cache(maxsize=512)
-def _decode_bits(data_shards: int, parity_shards: int, rows: tuple):
-    """Bit matrix recovering the k data rows from the given survivor rows."""
+def _decode_mat(data_shards: int, parity_shards: int, rows: tuple):
+    """GF matrix recovering the k data rows from the given survivor rows."""
     mat = np.asarray(encode_matrix(data_shards, parity_shards))
     sub = mat[list(rows)]
-    inv = gf256.mat_inv(sub)
-    return gf256_jax.bit_matrix(inv)
+    return gf256.mat_inv(sub)
 
 
-@partial(jax.jit, static_argnames=("parity_shards", "use_pallas"))
-def _encode_batch(data, abits, parity_shards, use_pallas=False):
+@lru_cache(maxsize=512)
+def _decode_mats(data_shards: int, parity_shards: int, rows: tuple):
+    """(dbits f32 [8k, 8k], pack f32 [k, 8k]) for the pallas path."""
+    inv = _decode_mat(data_shards, parity_shards, rows)
+    return (
+        gf256_jax.bit_matrix(inv).astype(np.float32),
+        gf256_jax._pack_matrix(data_shards),
+    )
+
+
+def _resolve_pallas(use_pallas) -> bool:
+    if use_pallas is None:
+        return jax.default_backend() == "tpu"
+    return bool(use_pallas)
+
+
+@partial(jax.jit, static_argnames=("out_rows", "tile_l"))
+def _apply_pallas(x, mbits, pack, out_rows, tile_l):
+    """[B, k, L] x one fused-pallas GF matmul -> [B, out_rows, L]."""
+    B, k, L = x.shape
+    flat = jnp.transpose(x, (1, 0, 2)).reshape(k, B * L)
+    pad = (-(B * L)) % tile_l
+    padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+    out = gf256_jax._gf_matmul_pallas(mbits, pack, padded, tile_l=tile_l)
+    out = out[:, : B * L]
+    return jnp.transpose(out.reshape(out_rows, B, L), (1, 0, 2))
+
+
+@partial(jax.jit, static_argnames=("parity_shards", "tile_l"))
+def _encode_batch_pallas(data, abits, pack, parity_shards, tile_l):
+    parity = _apply_pallas(data, abits, pack, parity_shards, tile_l)
+    return jnp.concatenate([data, parity], axis=1)
+
+
+@partial(jax.jit, static_argnames=("parity_shards",))
+def _encode_batch(data, abits, parity_shards):
     B, k, L = data.shape
     flat = jnp.transpose(data, (1, 0, 2)).reshape(k, B * L)
-    if use_pallas:
-        pad = (-(B * L)) % 512
-        padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
-        parity = gf256_jax._gf_matmul_pallas(abits, padded)[:, : B * L]
-    else:
-        parity = gf256_jax._bits_matmul(abits, flat)
+    parity = gf256_jax._bits_matmul(abits, flat)
     parity = jnp.transpose(parity.reshape(parity_shards, B, L), (1, 0, 2))
     return jnp.concatenate([data, parity], axis=1)
 
 
 def rs_encode_batch(
-    data, data_shards: int, parity_shards: int, use_pallas: bool = False
+    data, data_shards: int, parity_shards: int, use_pallas: bool | None = None
 ):
     """[B, k, L] uint8 -> [B, k+p, L]: systematic batch encode on device."""
     data = jnp.asarray(data, dtype=jnp.uint8)
     if data.ndim != 3 or data.shape[1] != data_shards:
         raise ValueError(f"expected [B, {data_shards}, L], got {data.shape}")
-    abits = _parity_bits(data_shards, parity_shards)
-    return _encode_batch(data, abits, parity_shards, use_pallas)
+    if _resolve_pallas(use_pallas):
+        abits, pack = _parity_mats(data_shards, parity_shards)
+        tile_l = gf256_jax.pallas_tile_l(parity_shards, data_shards)
+        return _encode_batch_pallas(data, abits, pack, parity_shards, tile_l)
+    return _encode_batch(data, _parity_bits(data_shards, parity_shards),
+                         parity_shards)
 
 
-@partial(jax.jit, static_argnames=("data_shards", "use_pallas"))
-def _reconstruct_batch(shards, dbits, data_shards, use_pallas):
+@partial(jax.jit, static_argnames=("data_shards",))
+def _reconstruct_batch(shards, dbits, data_shards):
     B, k, L = shards.shape
     flat = jnp.transpose(shards, (1, 0, 2)).reshape(k, B * L)
-    if use_pallas:
-        pad = (-(B * L)) % 512
-        padded = jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
-        out = gf256_jax._gf_matmul_pallas(dbits, padded)[:, : B * L]
-    else:
-        out = gf256_jax._bits_matmul(dbits, flat)
+    out = gf256_jax._bits_matmul(dbits, flat)
     return jnp.transpose(out.reshape(data_shards, B, L), (1, 0, 2))
 
 
@@ -84,7 +130,7 @@ def rs_reconstruct_batch(
     rows,
     data_shards: int,
     parity_shards: int,
-    use_pallas: bool = False,
+    use_pallas: bool | None = None,
 ):
     """Recover data rows for a batch sharing one survivor pattern.
 
@@ -95,5 +141,11 @@ def rs_reconstruct_batch(
     if len(rows) != data_shards:
         raise ValueError(f"need exactly {data_shards} survivor rows")
     surviving = jnp.asarray(surviving, dtype=jnp.uint8)
-    dbits = _decode_bits(data_shards, parity_shards, rows)
-    return _reconstruct_batch(surviving, dbits, data_shards, use_pallas)
+    if _resolve_pallas(use_pallas):
+        dbits, pack = _decode_mats(data_shards, parity_shards, rows)
+        tile_l = gf256_jax.pallas_tile_l(data_shards, data_shards)
+        return _apply_pallas(surviving, dbits, pack, data_shards, tile_l)
+    inv = _decode_mat(data_shards, parity_shards, rows)
+    return _reconstruct_batch(
+        surviving, gf256_jax.bit_matrix(inv), data_shards
+    )

@@ -66,7 +66,9 @@ def broadcast_round_sharded(
         np.asarray(rs_jax.encode_matrix(data_shards, parity_shards))[data_shards:]
     ))
     dec_rows = tuple(range(data_shards))
-    dbits = jnp.asarray(rs_jax._decode_bits(data_shards, parity_shards, dec_rows))
+    dbits = jnp.asarray(gf256_jax.bit_matrix(
+        rs_jax._decode_mat(data_shards, parity_shards, dec_rows)
+    ))
 
     @partial(
         jax.shard_map,

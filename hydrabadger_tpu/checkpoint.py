@@ -1,0 +1,257 @@
+"""Checkpoint / resume — the subsystem the reference lacks entirely.
+
+The reference keeps all state in memory and regenerates keys on every
+boot (/root/reference/src/hydrabadger/hydrabadger.rs:131); its only
+resume affordances are `Config.start_epoch` threading into DHB's era
+(hydrabadger.rs:58,69, state.rs:298) and the `JoinPlan` that lets a
+fresh node adopt the network's current epoch (handler.rs:256-264).
+SURVEY.md §5.4 prescribes what this module provides:
+
+  * **Node checkpoints** — a versioned, integrity-checked snapshot of a
+    node's durable consensus identity: uid, identity key, era/epoch
+    cursor, validator set, master `PublicKeySet`, and this node's
+    `SecretKeyShare`.  Restoring rebuilds a validator
+    `DynamicHoneyBadger` at the saved era with the in-era epoch
+    fast-forwarded — the same trick `from_join_plan` uses
+    (dynamic_honey_badger.py: `hb.epoch = plan.epoch - plan.era`) but
+    with key material, so the node comes back as a *validator*, not an
+    observer.  Serialized with the deterministic wire codec (no pickle:
+    checkpoints may cross trust boundaries).
+
+  * **Simulator checkpoints** — full-state snapshots of a `SimNetwork`
+    (every core's protocol state, router queue, RNGs), so a
+    thousand-epoch benchmark or a long adversarial soak can stop and
+    resume bit-identically.  Pickle-based: sim checkpoints stay inside
+    one trust domain, and the cores are plain Python objects.  Adversary
+    callables (closures) are stripped on save and re-attached on load.
+
+Both formats share a container: MAGIC | version | sha256(payload) |
+payload, so truncated or corrupted files fail loudly instead of
+resuming a consensus node from garbage.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from .consensus.dynamic_honey_badger import DynamicHoneyBadger
+from .consensus.types import NetworkInfo
+from .crypto.threshold import PublicKey, PublicKeySet, SecretKey, SecretKeyShare
+from .utils import codec
+
+_MAGIC = b"HBTPUCKP"
+_NODE_VERSION = 1
+_SIM_VERSION = 1
+
+
+class CheckpointError(ValueError):
+    pass
+
+
+def _pack(kind: int, payload: bytes) -> bytes:
+    digest = hashlib.sha256(payload).digest()
+    return _MAGIC + bytes([kind]) + digest + payload
+
+
+def _unpack(raw: bytes, kind: int) -> bytes:
+    if len(raw) < len(_MAGIC) + 1 + 32 or raw[: len(_MAGIC)] != _MAGIC:
+        raise CheckpointError("not a hydrabadger_tpu checkpoint")
+    if raw[len(_MAGIC)] != kind:
+        raise CheckpointError(
+            f"checkpoint kind mismatch: got {raw[len(_MAGIC)]}, want {kind}"
+        )
+    digest = raw[len(_MAGIC) + 1 : len(_MAGIC) + 33]
+    payload = raw[len(_MAGIC) + 33 :]
+    if hashlib.sha256(payload).digest() != digest:
+        raise CheckpointError("checkpoint integrity check failed")
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Node checkpoints (deterministic codec; no pickle)
+# ---------------------------------------------------------------------------
+
+_KIND_NODE = 1
+_KIND_SIM = 2
+
+
+@dataclass(frozen=True)
+class NodeCheckpoint:
+    """Durable consensus identity of one node at an epoch boundary."""
+
+    uid: object  # node id, verbatim (bytes in the net runtime)
+    secret_key: bytes  # node identity key (BLS scalar)
+    era: int
+    epoch: int  # absolute epoch cursor (next epoch to decide)
+    node_ids: Sequence  # current validator set, sorted (ids verbatim)
+    pub_keys: Dict  # node id -> identity PublicKey bytes
+    pk_set: bytes  # era's master PublicKeySet
+    sk_share: bytes  # this node's SecretKeyShare ('' for observers)
+    session_id: bytes = b"dhb"  # coin/session binding; must match peers
+
+    def to_bytes(self) -> bytes:
+        payload = codec.encode(
+            (
+                _NODE_VERSION,
+                self.uid,
+                self.secret_key,
+                self.era,
+                self.epoch,
+                tuple(self.node_ids),
+                tuple(sorted(self.pub_keys.items())),
+                self.pk_set,
+                self.sk_share,
+                self.session_id,
+            )
+        )
+        return _pack(_KIND_NODE, payload)
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "NodeCheckpoint":
+        fields = codec.decode(_unpack(raw, _KIND_NODE))
+        version = fields[0]
+        if version != _NODE_VERSION:
+            raise CheckpointError(f"unsupported node checkpoint v{version}")
+        (_v, uid, sk, era, epoch, node_ids, pub_items, pk_set, share,
+         session_id) = fields
+        return cls(
+            uid=uid,
+            secret_key=bytes(sk),
+            era=int(era),
+            epoch=int(epoch),
+            node_ids=tuple(node_ids),
+            pub_keys={k: bytes(v) for k, v in pub_items},
+            pk_set=bytes(pk_set),
+            sk_share=bytes(share),
+            session_id=bytes(session_id),
+        )
+
+    # -- capture / restore ---------------------------------------------------
+
+    @classmethod
+    def capture(cls, secret_key: SecretKey,
+                dhb: DynamicHoneyBadger) -> "NodeCheckpoint":
+        """Snapshot a running DynamicHoneyBadger's durable state."""
+        ni = dhb.netinfo
+        share = ni.sk_share.to_bytes() if ni.sk_share is not None else b""
+        return cls(
+            uid=dhb.our_id,
+            secret_key=secret_key.to_bytes(),
+            era=dhb.era,
+            epoch=dhb.epoch,
+            node_ids=tuple(ni.node_ids),
+            pub_keys={
+                n: pk.to_bytes() for n, pk in dhb.pub_keys.items()
+            },
+            pk_set=ni.pk_set.to_bytes(),
+            sk_share=share,
+            session_id=dhb.session_id,
+        )
+
+    def restore_dhb(
+        self,
+        encrypt: bool = True,
+        coin_mode: str = "threshold",
+        verify_shares: bool = True,
+        rng=None,
+        engine=None,
+    ) -> DynamicHoneyBadger:
+        """Rebuild the consensus core at the saved era/epoch.
+
+        Validator iff the checkpoint carries a key share; in-era epochs
+        already decided are skipped exactly as `from_join_plan` does."""
+        share = (
+            SecretKeyShare.from_bytes(self.sk_share) if self.sk_share else None
+        )
+        netinfo = NetworkInfo(
+            self.uid,
+            list(self.node_ids),
+            PublicKeySet.from_bytes(self.pk_set),
+            share,
+        )
+        dhb = DynamicHoneyBadger(
+            self.uid,
+            SecretKey.from_bytes(self.secret_key),
+            netinfo,
+            {n: PublicKey.from_bytes(pk) for n, pk in self.pub_keys.items()},
+            era=self.era,
+            epoch=self.epoch,
+            session_id=self.session_id,
+            encrypt=encrypt,
+            coin_mode=coin_mode,
+            verify_shares=verify_shares,
+            rng=rng,
+            engine=engine,
+        )
+        dhb.hb.epoch = self.epoch - self.era
+        return dhb
+
+
+def _atomic_write(path: str, blob: bytes) -> None:
+    """Write via temp file + rename so an interrupted save never destroys
+    the previous good checkpoint (the crash the feature exists to survive)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def save_node(path: str, ckpt: NodeCheckpoint) -> None:
+    _atomic_write(path, ckpt.to_bytes())
+
+
+def load_node(path: str) -> NodeCheckpoint:
+    with open(path, "rb") as f:
+        return NodeCheckpoint.from_bytes(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Simulator checkpoints (full state; single trust domain)
+# ---------------------------------------------------------------------------
+
+
+def sim_to_bytes(sim) -> bytes:
+    """Serialize a SimNetwork with adversary callables stripped."""
+    cfg_adv, router_adv = sim.cfg.adversary, sim.router.adversary
+    sim.cfg.adversary = sim.router.adversary = None
+    try:
+        buf = io.BytesIO()
+        pickle.Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(
+            (_SIM_VERSION, cfg_adv is not None, sim)
+        )
+        return _pack(_KIND_SIM, buf.getvalue())
+    finally:
+        sim.cfg.adversary, sim.router.adversary = cfg_adv, router_adv
+
+
+def sim_from_bytes(raw: bytes, adversary=None):
+    """Restore a SimNetwork; re-attach `adversary` if one was stripped.
+
+    Note: an adversary's internal RNG restarts from its own seed, so a
+    resumed adversarial run is deterministic but not identical to the
+    uninterrupted one; adversary-free runs resume bit-identically."""
+    version, had_adversary, sim = pickle.loads(_unpack(raw, _KIND_SIM))
+    if version != _SIM_VERSION:
+        raise CheckpointError(f"unsupported sim checkpoint v{version}")
+    if had_adversary and adversary is None:
+        raise CheckpointError(
+            "checkpointed sim ran with an adversary; pass adversary= to "
+            "resume (callables are not serialized)"
+        )
+    sim.cfg.adversary = sim.router.adversary = adversary
+    return sim
+
+
+def save_sim(path: str, sim) -> None:
+    _atomic_write(path, sim_to_bytes(sim))
+
+
+def load_sim(path: str, adversary=None):
+    with open(path, "rb") as f:
+        return sim_from_bytes(f.read(), adversary=adversary)

@@ -140,7 +140,16 @@ class Hydrabadger:
         self.uid = uid or Uid()
         self.bind = bind
         self.cfg = config or Config()
-        self.rng = random.Random(seed if seed is not None else int.from_bytes(self.uid.bytes[:8], "big"))
+        # seed=None must mean real entropy: the uid is broadcast in every
+        # hello frame, so deriving the RNG (hence the identity secret key
+        # and encryption randomness) from it would be publicly replayable.
+        # Explicit seeds remain available for deterministic tests.
+        import os as _os
+
+        self.rng = random.Random(
+            seed if seed is not None
+            else int.from_bytes(_os.urandom(16), "big")
+        )
         self.secret_key = SecretKey.random(self.rng)
         self.public_key = self.secret_key.public_key()
         self.peers = Peers()
@@ -191,6 +200,45 @@ class Hydrabadger:
             return False
         self._internal.put_nowait(("api_vote", tuple(change)))
         return True
+
+    def checkpoint(self):
+        """Snapshot durable consensus identity (SURVEY.md §5.4).
+
+        Only meaningful once the network is active (validator/observer);
+        raises otherwise."""
+        from ..checkpoint import NodeCheckpoint
+
+        if self.dhb is None:
+            raise RuntimeError("nothing to checkpoint: network not active")
+        return NodeCheckpoint.capture(self.secret_key, self.dhb)
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        bind: InAddr,
+        ckpt,
+        config: Optional[Config] = None,
+        seed: Optional[int] = None,
+    ) -> "Hydrabadger":
+        """Rebuild a node from a NodeCheckpoint: same identity and keys,
+        consensus core fast-forwarded to the saved era/epoch.  The node
+        rejoins as validator (or observer if the checkpoint has no key
+        share) instead of re-running DKG — the resume path the reference
+        approximates with start_epoch + JoinPlan (state.rs:298,
+        handler.rs:256-264)."""
+        node = cls(bind, config, uid=Uid(ckpt.uid), seed=seed)
+        node.secret_key = SecretKey.from_bytes(ckpt.secret_key)
+        node.public_key = node.secret_key.public_key()
+        node.dhb = ckpt.restore_dhb(
+            encrypt=node.cfg.encrypt,
+            coin_mode=node.cfg.coin_mode,
+            verify_shares=node.cfg.verify_shares,
+            rng=node.rng,
+            engine=node.cfg.engine,
+        )
+        node.current_epoch = ckpt.epoch
+        node.state = "validator" if ckpt.sk_share else "observer"
+        return node
 
     def new_key_gen_instance(self) -> asyncio.Queue:
         """Start a user-scoped DKG among current validators; events

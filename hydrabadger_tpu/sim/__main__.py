@@ -2,6 +2,9 @@
 
     python -m hydrabadger_tpu.sim --nodes 16 --epochs 10
     python -m hydrabadger_tpu.sim --nodes 4 --encrypt --coin threshold --json
+    python -m hydrabadger_tpu.sim --nodes 4 --epochs 100 \
+        --checkpoint /tmp/sim.ckpt --checkpoint-every 25
+    python -m hydrabadger_tpu.sim --resume /tmp/sim.ckpt --epochs 50
 """
 from __future__ import annotations
 
@@ -9,7 +12,25 @@ import argparse
 import json
 import sys
 
-from .network import SimConfig, SimNetwork, drop_adversary, duplicate_adversary
+from .network import (
+    SimConfig,
+    SimNetwork,
+    byzantine_adversary,
+    crash_adversary,
+    delay_adversary,
+    drop_adversary,
+    duplicate_adversary,
+)
+
+
+def _node_list(spec: str, n: int):
+    ids = []
+    for part in spec.split(","):
+        idx = int(part)
+        if not 0 <= idx < n:
+            raise ValueError(f"node index {idx} out of range (n={n})")
+        ids.append(f"n{idx:03d}")
+    return ids
 
 
 def main(argv=None) -> int:
@@ -32,37 +53,108 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--drop", type=float, default=0.0, help="message drop rate")
     p.add_argument("--dup", type=float, default=0.0, help="message duplication rate")
+    p.add_argument("--delay", type=float, default=0.0, help="message delay rate")
+    p.add_argument(
+        "--crash", default=None, metavar="I,J,...",
+        help="fail-stop these node indices (silenced from the start)",
+    )
+    p.add_argument(
+        "--byzantine", default=None, metavar="I,J,...",
+        help="these node indices replay old messages alongside real traffic",
+    )
     p.add_argument("--json", action="store_true", help="emit metrics as JSON")
+    p.add_argument(
+        "--checkpoint", default=None, metavar="PATH",
+        help="write a full-state sim checkpoint when the run finishes",
+    )
+    p.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="N",
+        help="also checkpoint every N epochs during the run",
+    )
+    p.add_argument(
+        "--resume", default=None, metavar="PATH",
+        help="resume from a sim checkpoint instead of starting fresh "
+        "(--epochs counts additional epochs; topology flags are ignored)",
+    )
     args = p.parse_args(argv)
     if args.nodes < 1:
         p.error("--nodes must be >= 1")
     if args.epochs < 1:
         p.error("--epochs must be >= 1")
-    if not 0.0 <= args.drop <= 1.0 or not 0.0 <= args.dup <= 1.0:
-        p.error("--drop/--dup must be in [0, 1]")
+    for name in ("drop", "dup", "delay"):
+        if not 0.0 <= getattr(args, name) <= 1.0:
+            p.error(f"--{name} must be in [0, 1]")
+    if args.checkpoint_every and not args.checkpoint:
+        p.error("--checkpoint-every requires --checkpoint")
 
+    fault_flags = [
+        name
+        for name, active in [
+            ("--drop", args.drop > 0),
+            ("--dup", args.dup > 0),
+            ("--delay", args.delay > 0),
+            ("--crash", args.crash is not None),
+            ("--byzantine", args.byzantine is not None),
+        ]
+        if active
+    ]
+    if len(fault_flags) > 1:
+        p.error(
+            f"{' and '.join(fault_flags)} are mutually exclusive "
+            "(one adversary schedule per run)"
+        )
     adversary = None
     if args.drop > 0:
         adversary = drop_adversary(args.drop, args.seed)
     elif args.dup > 0:
         adversary = duplicate_adversary(args.dup, args.seed)
+    elif args.delay > 0:
+        adversary = delay_adversary(args.delay, seed=args.seed)
+    elif args.crash is not None:
+        adversary = crash_adversary(_node_list(args.crash, args.nodes))
+    elif args.byzantine is not None:
+        adversary = byzantine_adversary(
+            _node_list(args.byzantine, args.nodes), seed=args.seed
+        )
 
-    cfg = SimConfig(
-        n_nodes=args.nodes,
-        protocol=args.protocol,
-        epochs=args.epochs,
-        txns_per_node_per_epoch=args.txns,
-        txn_bytes=args.txn_bytes,
-        batch_size=args.batch_size,
-        encrypt=args.encrypt,
-        coin_mode=args.coin,
-        verify_shares=args.verify,
-        engine=args.engine,
-        seed=args.seed,
-        adversary=adversary,
-    )
-    net = SimNetwork(cfg)
-    metrics = net.run()
+    if args.resume:
+        from .. import checkpoint as ckpt_mod
+
+        net = ckpt_mod.load_sim(args.resume, adversary=adversary)
+    else:
+        cfg = SimConfig(
+            n_nodes=args.nodes,
+            protocol=args.protocol,
+            epochs=args.epochs,
+            txns_per_node_per_epoch=args.txns,
+            txn_bytes=args.txn_bytes,
+            batch_size=args.batch_size,
+            encrypt=args.encrypt,
+            coin_mode=args.coin,
+            verify_shares=args.verify,
+            engine=args.engine,
+            seed=args.seed,
+            adversary=adversary,
+        )
+        net = SimNetwork(cfg)
+
+    if args.checkpoint and args.checkpoint_every:
+        from .. import checkpoint as ckpt_mod
+
+        remaining = args.epochs
+        metrics = None
+        while remaining > 0:
+            chunk = min(args.checkpoint_every, remaining)
+            metrics = net.run(chunk)
+            remaining -= chunk
+            ckpt_mod.save_sim(args.checkpoint, net)
+    else:
+        metrics = net.run(args.epochs)
+        if args.checkpoint:
+            from .. import checkpoint as ckpt_mod
+
+            ckpt_mod.save_sim(args.checkpoint, net)
+
     if args.json:
         print(json.dumps(metrics.as_dict()))
     else:

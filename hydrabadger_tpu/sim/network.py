@@ -150,6 +150,7 @@ class SimNetwork:
             shuffle=cfg.shuffle,
         )
         self._txn_counter = 0
+        self.total_wall_s = 0.0  # cumulative across run() calls / resumes
 
     def _handle(self, me, sender, message):
         return self.nodes[me].handle_message(sender, message)
@@ -185,12 +186,15 @@ class SimNetwork:
         self.router.run()
 
     def run(self, epochs: Optional[int] = None) -> SimMetrics:
+        """Run `epochs` more epochs; metrics are lifetime-cumulative (all
+        counters AND wall_s), so chunked/resumed runs report true rates."""
         epochs = self.cfg.epochs if epochs is None else epochs
         m = SimMetrics()
         t0 = time.perf_counter()
         for _ in range(epochs):
             self.run_epoch()
-        m.wall_s = time.perf_counter() - t0
+        self.total_wall_s += time.perf_counter() - t0
+        m.wall_s = self.total_wall_s
         m.messages_delivered = self.router.delivered
         m.faults = len(self.router.faults)
         m.epochs_done = min(len(self._batches(nid)) for nid in self.ids)
@@ -244,7 +248,82 @@ def duplicate_adversary(rate: float, seed: int = 0) -> Callable:
 
     def adv(sender, recipient, message):
         if rng.random() < rate:
-            return [(recipient, message), (recipient, message)]
+            return [(sender, recipient, message), (sender, recipient, message)]
         return None
+
+    return adv
+
+
+def delay_adversary(rate: float, max_delay: int = 64, seed: int = 0) -> Callable:
+    """Hold a fraction of messages back, releasing each after 1..max_delay
+    later deliveries pass it — models reordering/latency asymmetric links.
+    HBBFT is asynchronous-safe, so agreement must survive any delay."""
+    rng = random.Random(seed)
+    held: List[tuple] = []  # (release_countdown, sender, recipient, message)
+
+    def adv(sender, recipient, message):
+        out = []  # releases as explicit (sender, rec, msg) triples so the
+        for i in range(len(held) - 1, -1, -1):  # original sender survives
+            cnt, s, r, m = held[i]
+            if cnt <= 1:
+                out.append((s, r, m))
+                held.pop(i)
+            else:
+                held[i] = (cnt - 1, s, r, m)
+        if rng.random() < rate:
+            held.append((rng.randint(1, max_delay), sender, recipient, message))
+            return out
+        return out + [(sender, recipient, message)]
+
+    def flush():
+        """Release everything still held (called by the router at
+        quiescence so delays model reordering, not loss)."""
+        released = [(s, r, m) for _cnt, s, r, m in held]
+        held.clear()
+        return released
+
+    adv.flush = flush
+    return adv
+
+
+def crash_adversary(crashed, after_deliveries: int = 0) -> Callable:
+    """Fail-stop: silence all traffic from `crashed` nodes, optionally
+    after letting their first `after_deliveries` point-to-point
+    deliveries through (0 = silent from the start; note one multicast
+    counts once per recipient).  With |crashed| <= f the remaining nodes
+    must keep committing identical batches."""
+    crashed = set(crashed)
+    sent: Dict = {}
+
+    def adv(sender, recipient, message):
+        if sender in crashed:
+            n = sent.get(sender, 0) + 1
+            sent[sender] = n
+            if n > after_deliveries:
+                return []
+        return None
+
+    return adv
+
+
+def byzantine_adversary(corrupt, seed: int = 0) -> Callable:
+    """Corrupt nodes replay earlier messages to random victims on top of
+    their real traffic — equivocation-flavoured noise.  With
+    |corrupt| <= f, honest nodes must still agree; cores are expected to
+    log faults for garbage, not diverge."""
+    corrupt = set(corrupt)
+    rng = random.Random(seed)
+    history: List[tuple] = []
+
+    def adv(sender, recipient, message):
+        if sender not in corrupt:
+            return None
+        out = [(sender, recipient, message)]
+        if history and rng.random() < 0.5:
+            _, old = history[rng.randrange(len(history))]
+            out.append((sender, recipient, old))
+        if len(history) < 10_000:
+            history.append((sender, message))
+        return out
 
     return adv

@@ -17,9 +17,13 @@ from ..consensus.types import Step, TargetedMessage
 
 N = TypeVar("N", bound=Hashable)
 
-# adversary: fn(sender, recipient, message) -> list of (recipient, message)
-# deliveries (empty = drop; >1 = duplicate); None = deliver unchanged.
-Adversary = Callable[[Any, Any, Any], Optional[List[Tuple[Any, Any]]]]
+# adversary: fn(sender, recipient, message) -> None to deliver unchanged,
+# or a list of (sender, recipient, message) triples replacing the delivery
+# (empty = drop; >1 = duplicate; sender is explicit so held/forged traffic
+# keeps its true origin).  An adversary may also expose `flush()` returning
+# such triples; the router calls it at quiescence so schedules that hold
+# messages back (delay) model reordering, never permanent loss.
+Adversary = Callable[[Any, Any, Any], Optional[List[Tuple[Any, Any, Any]]]]
 
 
 class Router:
@@ -58,8 +62,7 @@ class Router:
         if self.adversary is not None:
             replacement = self.adversary(sender, recipient, message)
             if replacement is not None:
-                for rec, msg in replacement:
-                    self.queue.append((sender, rec, msg))
+                self.queue.extend(replacement)
                 return
         self.queue.append((sender, recipient, message))
 
@@ -82,8 +85,16 @@ class Router:
 
     def run(self, max_messages: int = 1_000_000) -> int:
         count = 0
-        while self.deliver_one():
-            count += 1
-            if count > max_messages:
-                raise RuntimeError("router did not quiesce (livelock?)")
-        return count
+        while True:
+            while self.deliver_one():
+                count += 1
+                if count > max_messages:
+                    raise RuntimeError("router did not quiesce (livelock?)")
+            # adversaries holding messages (e.g. delay) release them at
+            # quiescence: delays model reordering, not permanent loss
+            flush = getattr(self.adversary, "flush", None)
+            released = flush() if flush is not None else None
+            if not released:
+                return count
+            for sender, recipient, message in released:
+                self.queue.append((sender, recipient, message))

@@ -54,7 +54,10 @@ def test_mixed_inputs_agree(seed):
 
 def test_agreement_under_message_duplication():
     def adversary(sender, recipient, message):
-        return [(recipient, message), (recipient, message)]  # duplicate all
+        return [  # duplicate all
+            (sender, recipient, message),
+            (sender, recipient, message),
+        ]
 
     router, _ = run_aba(4, [True, False, True, False], adversary=adversary)
     decisions = {tuple(v) for v in router.outputs.values()}

@@ -1,0 +1,158 @@
+"""Checkpoint / resume (SURVEY.md §5.4 — absent from the reference).
+
+Covers: node-checkpoint codec round-trip + integrity, consensus
+continuation after restoring every node from its checkpoint, sim
+full-state determinism (interrupted == uninterrupted), adversary
+stripping, and the sim CLI flags.
+"""
+import random
+
+import pytest
+
+from hydrabadger_tpu import checkpoint as ckpt
+from hydrabadger_tpu.consensus.types import Step
+from hydrabadger_tpu.sim.__main__ import main as sim_main
+from hydrabadger_tpu.sim.network import (
+    SimConfig,
+    SimNetwork,
+    drop_adversary,
+)
+from hydrabadger_tpu.sim.router import Router
+
+
+def _dhb_sim(n=4, epochs=1, seed=7):
+    cfg = SimConfig(
+        n_nodes=n, protocol="dhb", epochs=epochs, encrypt=False,
+        coin_mode="hash", seed=seed,
+    )
+    net = SimNetwork(cfg)
+    net.run(epochs)
+    return net
+
+
+def _batch_keys(node):
+    out = []
+    for b in node.batches:
+        items = []
+        for p, v in sorted(b.contributions.items()):
+            if isinstance(v, (list, tuple)):
+                items.append((p, tuple(bytes(x) for x in v)))
+            else:
+                items.append((p, bytes(v)))
+        out.append(tuple(items))
+    return out
+
+
+class TestNodeCheckpoint:
+    def test_roundtrip(self):
+        net = _dhb_sim()
+        nid = net.ids[0]
+        dhb = net.nodes[nid]
+        cp = ckpt.NodeCheckpoint.capture(net.id_sks[nid], dhb)
+        again = ckpt.NodeCheckpoint.from_bytes(cp.to_bytes())
+        assert again == cp
+        assert again.era == dhb.era and again.epoch == dhb.epoch
+        assert again.sk_share  # captured as validator
+
+    def test_integrity_and_kind_checks(self):
+        net = _dhb_sim()
+        nid = net.ids[0]
+        raw = bytearray(ckpt.NodeCheckpoint.capture(
+            net.id_sks[nid], net.nodes[nid]
+        ).to_bytes())
+        raw[-1] ^= 0xFF
+        with pytest.raises(ckpt.CheckpointError):
+            ckpt.NodeCheckpoint.from_bytes(bytes(raw))
+        with pytest.raises(ckpt.CheckpointError):
+            ckpt.NodeCheckpoint.from_bytes(b"garbage")
+        with pytest.raises(ckpt.CheckpointError):
+            ckpt.sim_from_bytes(bytes(raw))  # node ckpt is not a sim ckpt
+
+    def test_restored_network_keeps_committing(self):
+        """Restore EVERY node from its checkpoint and run another epoch:
+        the rebuilt cores must agree — the restart-the-world scenario."""
+        net = _dhb_sim(n=4, epochs=2)
+        epoch0 = net.nodes[net.ids[0]].epoch
+        cps = {
+            nid: ckpt.NodeCheckpoint.capture(net.id_sks[nid], net.nodes[nid])
+            for nid in net.ids
+        }
+        # wire-format round-trip, then rebuild
+        restored = {
+            nid: ckpt.NodeCheckpoint.from_bytes(cp.to_bytes()).restore_dhb(
+                encrypt=False, coin_mode="hash",
+                rng=random.Random(100 + i),
+            )
+            for i, (nid, cp) in enumerate(sorted(cps.items()))
+        }
+        nodes = dict(restored)
+        router = Router(
+            list(nodes), lambda me, s, m: nodes[me].handle_message(s, m),
+            seed=1, shuffle=True,
+        )
+        rng = random.Random(42)
+        for nid, dhb in nodes.items():
+            assert dhb.is_validator
+            assert dhb.epoch == epoch0
+            router.dispatch_step(
+                nid, dhb.propose(b"post-restore-" + nid.encode(), rng)
+            )
+        router.run()
+        batches = {nid: dhb.batches for nid, dhb in nodes.items()}
+        assert all(len(b) == 1 for b in batches.values())
+        first = [sorted(b[0].contributions.items()) for b in batches.values()]
+        assert all(f == first[0] for f in first)
+        assert all(b[0].epoch == epoch0 for b in batches.values())
+
+
+class TestSimCheckpoint:
+    def test_resume_bit_identical(self):
+        cfg = dict(n_nodes=4, protocol="qhb", seed=3)
+        straight = SimNetwork(SimConfig(**cfg))
+        straight.run(6)
+
+        interrupted = SimNetwork(SimConfig(**cfg))
+        interrupted.run(3)
+        blob = ckpt.sim_to_bytes(interrupted)
+        resumed = ckpt.sim_from_bytes(blob)
+        resumed.run(3)
+
+        a = {n: _batch_keys(straight.nodes[n]) for n in straight.ids}
+        b = {n: _batch_keys(resumed.nodes[n]) for n in resumed.ids}
+        assert a == b
+        assert len(a[straight.ids[0]]) == 6
+
+    def test_save_does_not_disturb_live_sim(self):
+        adv = drop_adversary(0.05, seed=9)
+        net = SimNetwork(SimConfig(n_nodes=4, seed=5, adversary=adv))
+        net.run(1)
+        ckpt.sim_to_bytes(net)
+        assert net.cfg.adversary is adv  # re-attached after save
+        assert net.router.adversary is adv
+        net.run(1)  # still functional
+
+    def test_adversary_required_on_resume(self):
+        adv = drop_adversary(0.05, seed=9)
+        net = SimNetwork(SimConfig(n_nodes=4, seed=5, adversary=adv))
+        net.run(1)
+        blob = ckpt.sim_to_bytes(net)
+        with pytest.raises(ckpt.CheckpointError, match="adversary"):
+            ckpt.sim_from_bytes(blob)
+        resumed = ckpt.sim_from_bytes(blob, adversary=drop_adversary(0.05, 9))
+        resumed.run(1)
+
+
+class TestCli:
+    def test_checkpoint_and_resume_flags(self, tmp_path, capsys):
+        path = tmp_path / "sim.ckpt"
+        rc = sim_main([
+            "--nodes", "4", "--epochs", "2", "--json",
+            "--checkpoint", str(path), "--checkpoint-every", "1",
+        ])
+        assert rc == 0 and path.exists()
+        rc = sim_main(["--resume", str(path), "--epochs", "2", "--json"])
+        assert rc == 0
+        import json as _json
+
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert _json.loads(lines[-1])["epochs_done"] == 4

@@ -103,7 +103,7 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
     reference's threshold_crypto performs node-by-node inside
     hbbft::threshold_decrypt; measured on a sample and extrapolated
     (the loop is steady-state).  The TPU path runs every
-    (epoch x node) share as one lane of a single 255-step
+    (epoch x node) share as one lane of a single windowed (w=4)
     double-and-add kernel.
     """
     import random
@@ -134,12 +134,12 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
 
     # TPU path: all epochs x nodes shares in one kernel
     points = bj.points_to_limbs([u for u in us for _ in range(n_nodes)])
-    bits = bj.scalars_to_bits(sks * epochs)
+    wins = bj.scalars_to_windows(sks * epochs)
     dev_pts = jax.device_put(points)
-    dev_bits = jax.device_put(bits)
-    _sync(bj.jac_scalar_mul(dev_pts, dev_bits))  # compile + warm
+    dev_wins = jax.device_put(wins)
+    _sync(bj.jac_scalar_mul_windowed(dev_pts, dev_wins))  # compile + warm
     t0 = time.perf_counter()
-    _sync(bj.jac_scalar_mul(dev_pts, dev_bits))
+    _sync(bj.jac_scalar_mul_windowed(dev_pts, dev_wins))
     dt = time.perf_counter() - t0
     accel_sps = epochs * n_nodes / dt
     return {

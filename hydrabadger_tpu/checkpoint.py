@@ -15,8 +15,11 @@ SURVEY.md §5.4 prescribes what this module provides:
     fast-forwarded — the same trick `from_join_plan` uses
     (dynamic_honey_badger.py: `hb.epoch = plan.epoch - plan.era`) but
     with key material, so the node comes back as a *validator*, not an
-    observer.  Serialized with the deterministic wire codec (no pickle:
-    checkpoints may cross trust boundaries).
+    observer.  Serialized with the deterministic wire codec rather than
+    pickle so *loading* an untrusted or corrupted file can never execute
+    code — but the payload contains the node's identity secret key and
+    threshold key share IN PLAINTEXT: a checkpoint is as secret as the
+    keys themselves and must never leave the operator's trust domain.
 
   * **Simulator checkpoints** — full-state snapshots of a `SimNetwork`
     (every core's protocol state, router queue, RNGs), so a

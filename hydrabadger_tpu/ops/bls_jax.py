@@ -26,9 +26,11 @@ Design (TPU-first, not a bignum port):
     branch-free formulas with `where` masks for the inf/equal cases, so
     they map cleanly onto SIMD lanes — no data-dependent control flow
     under jit (the XLA compilation-model constraint).
-  - Scalar multiplication is a 255-step `lax.scan` of double-and-add
-    over MSB-first bit columns; the whole batch shares the loop, each
-    lane selects with its own bit.
+  - Scalar multiplication: a 255-step `lax.scan` of double-and-add
+    over MSB-first bit columns (`jac_scalar_mul`), and the production
+    fixed-window w=4 ladder (`jac_scalar_mul_windowed`): 64 windows of
+    4 doubles + 1 one-hot table add — ~2x fewer field muls.  The whole
+    batch shares the loop; each lane selects with its own digits.
 
 The pure-Python `crypto/bls12_381.py` engine is the bit-exactness oracle
 (tests/test_bls_jax.py); `crypto/engine.TpuEngine` routes the batch
@@ -293,15 +295,63 @@ def jac_scalar_mul(points: jax.Array, bits: jax.Array) -> jax.Array:
     return acc
 
 
-@jax.jit
-def jac_weighted_sum(points: jax.Array, bits: jax.Array) -> jax.Array:
-    """sum_s coeff[s] * P[s] per batch row.
+WINDOW_BITS = 4  # jac_scalar_mul_windowed's fixed window width
 
-    points: [..., S, 3, 32], bits: [..., S, 255] -> [..., 3, 32].
-    The Lagrange-combine-in-the-exponent kernel: every instance's share
-    set reduces in lockstep.
+
+def scalars_to_windows(scalars: Sequence[int], n_bits: int = 256) -> np.ndarray:
+    """Python ints -> [B, n_bits/4] int32 4-bit windows, MSB first
+    (the digit format jac_scalar_mul_windowed consumes)."""
+    w = WINDOW_BITS
+    bits = scalars_to_bits(scalars, n_bits)  # [B, n_bits] MSB-first
+    b, n = bits.shape
+    weights = (1 << np.arange(w - 1, -1, -1)).astype(np.int32)
+    return bits.reshape(b, n // w, w) @ weights
+
+
+@jax.jit
+def jac_scalar_mul_windowed(points: jax.Array, windows: jax.Array) -> jax.Array:
+    """Fixed-window (w=4) scalar mul: ~2x fewer field muls than
+    double-and-add.
+
+    points: [..., 3, 32], windows: [..., n_windows] MSB-first 4-bit
+    digits.  Per lane: precompute T = [inf, P, 2P, ..., 15P] (14 adds +
+    1 double), then each window costs 4 doubles + 1 table-add, with the
+    table lookup as a one-hot einsum — no gathers, no data-dependent
+    control flow.
     """
-    terms = jac_scalar_mul(points, bits)  # [..., S, 3, 32]
+    batch = points.shape[:-2]
+
+    # T[i] = i*P by a 15-step chain scan (one jac_add in the graph)
+    def tbl_step(prev, _):
+        nxt = jac_add(prev, points)
+        return nxt, nxt
+
+    _, chain = jax.lax.scan(tbl_step, points, None, length=14)
+    t = jnp.concatenate(
+        [
+            jac_infinity(batch)[None],
+            points[None],
+            chain,  # [14, ..., 3, 32] = 2P..15P
+        ],
+        axis=0,
+    )
+    t = jnp.moveaxis(t, 0, -3)  # [..., 16, 3, 32]
+
+    acc0 = jac_infinity(batch)
+
+    def step(acc, win_col):
+        acc = jax.lax.fori_loop(0, 4, lambda _i, a: jac_double(a), acc)
+        onehot = (
+            win_col[..., None] == jnp.arange(16, dtype=win_col.dtype)
+        ).astype(jnp.int32)  # [..., 16]
+        sel = jnp.einsum("...t,...tcl->...cl", onehot, t)
+        return jac_add(acc, sel), None
+
+    acc, _ = jax.lax.scan(step, acc0, jnp.moveaxis(windows, -1, 0))
+    return acc
+
+
+def _reduce_tree(terms: jax.Array) -> jax.Array:
     s = terms.shape[-3]
     # S is static (the share-quorum size): unroll the reduction tree so
     # every level is one batched jac_add over [..., S/2] lanes.
@@ -314,6 +364,25 @@ def jac_weighted_sum(points: jax.Array, bits: jax.Array) -> jax.Array:
             nxt.append(cols[-1])
         cols = nxt
     return cols[0]
+
+
+@jax.jit
+def jac_weighted_sum(points: jax.Array, bits: jax.Array) -> jax.Array:
+    """sum_s coeff[s] * P[s] per batch row.
+
+    points: [..., S, 3, 32], bits: [..., S, 255] -> [..., 3, 32].
+    The Lagrange-combine-in-the-exponent kernel: every instance's share
+    set reduces in lockstep.
+    """
+    terms = jac_scalar_mul(points, bits)  # [..., S, 3, 32]
+    return _reduce_tree(terms)
+
+
+@jax.jit
+def jac_weighted_sum_windowed(points: jax.Array, windows: jax.Array) -> jax.Array:
+    """jac_weighted_sum with the windowed ladder: [..., S, 3, 32] x
+    [..., S, 64] -> [..., 3, 32]."""
+    return _reduce_tree(jac_scalar_mul_windowed(points, windows))
 
 
 # ---------------------------------------------------------------------------
@@ -405,8 +474,8 @@ def g1_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
     CPU points out.  This is decrypt-share generation for a whole batch
     of (instance, node) pairs at once."""
     pts = jnp.asarray(points_to_limbs(points))
-    bits = jnp.asarray(scalars_to_bits([s % bls.R for s in scalars]))
-    return limbs_to_points(jac_scalar_mul(pts, bits))
+    wins = jnp.asarray(scalars_to_windows([s % bls.R for s in scalars]))
+    return limbs_to_points(jac_scalar_mul_windowed(pts, wins))
 
 
 def g1_weighted_sum_batch(
@@ -424,11 +493,13 @@ def g1_weighted_sum_batch(
     pts = np.stack(
         [points_to_limbs(row) for row in points_batch]
     )  # [B, S, 3, 32]
-    bits = np.stack(
+    wins = np.stack(
         [
-            scalars_to_bits([c % bls.R for c in row])
+            scalars_to_windows([c % bls.R for c in row])
             for row in coeffs_batch
         ]
-    )  # [B, S, 255]
-    assert pts.shape[:2] == (b, s) and bits.shape[:2] == (b, s)
-    return limbs_to_points(jac_weighted_sum(jnp.asarray(pts), jnp.asarray(bits)))
+    )  # [B, S, 64]
+    assert pts.shape[:2] == (b, s) and wins.shape[:2] == (b, s)
+    return limbs_to_points(
+        jac_weighted_sum_windowed(jnp.asarray(pts), jnp.asarray(wins))
+    )

@@ -24,6 +24,7 @@ from .network import (
 
 
 def _node_list(spec: str, n: int):
+    """Parse "I,J,..." indices -> sim node ids, range-checked against n."""
     ids = []
     for part in spec.split(","):
         idx = int(part)
@@ -103,24 +104,43 @@ def main(argv=None) -> int:
             f"{' and '.join(fault_flags)} are mutually exclusive "
             "(one adversary schedule per run)"
         )
+    from .. import checkpoint as ckpt_mod
+
+    # --crash/--byzantine indices must be validated against the sim that
+    # will actually run: on --resume that is the checkpointed topology,
+    # not the CLI --nodes value
+    n_nodes = args.nodes
+    resumed = None
+    if args.resume:
+        if fault_flags:
+            # a fresh adversary replaces whatever the checkpoint ran with
+            resumed = ckpt_mod.load_sim(args.resume, adversary="pending")
+        else:
+            # raises if the checkpoint ran adversarially and no schedule
+            # was re-supplied (callables are not serialized)
+            resumed = ckpt_mod.load_sim(args.resume)
+        n_nodes = resumed.cfg.n_nodes
+
     adversary = None
-    if args.drop > 0:
-        adversary = drop_adversary(args.drop, args.seed)
-    elif args.dup > 0:
-        adversary = duplicate_adversary(args.dup, args.seed)
-    elif args.delay > 0:
-        adversary = delay_adversary(args.delay, seed=args.seed)
-    elif args.crash is not None:
-        adversary = crash_adversary(_node_list(args.crash, args.nodes))
-    elif args.byzantine is not None:
-        adversary = byzantine_adversary(
-            _node_list(args.byzantine, args.nodes), seed=args.seed
-        )
+    try:
+        if args.drop > 0:
+            adversary = drop_adversary(args.drop, args.seed)
+        elif args.dup > 0:
+            adversary = duplicate_adversary(args.dup, args.seed)
+        elif args.delay > 0:
+            adversary = delay_adversary(args.delay, seed=args.seed)
+        elif args.crash is not None:
+            adversary = crash_adversary(_node_list(args.crash, n_nodes))
+        elif args.byzantine is not None:
+            adversary = byzantine_adversary(
+                _node_list(args.byzantine, n_nodes), seed=args.seed
+            )
+    except ValueError as exc:
+        p.error(str(exc))
 
     if args.resume:
-        from .. import checkpoint as ckpt_mod
-
-        net = ckpt_mod.load_sim(args.resume, adversary=adversary)
+        net = resumed
+        net.cfg.adversary = net.router.adversary = adversary
     else:
         cfg = SimConfig(
             n_nodes=args.nodes,
@@ -139,8 +159,6 @@ def main(argv=None) -> int:
         net = SimNetwork(cfg)
 
     if args.checkpoint and args.checkpoint_every:
-        from .. import checkpoint as ckpt_mod
-
         remaining = args.epochs
         metrics = None
         while remaining > 0:
@@ -151,8 +169,6 @@ def main(argv=None) -> int:
     else:
         metrics = net.run(args.epochs)
         if args.checkpoint:
-            from .. import checkpoint as ckpt_mod
-
             ckpt_mod.save_sim(args.checkpoint, net)
 
     if args.json:

@@ -132,3 +132,24 @@ def test_combine_rejects_below_threshold():
     share = sk_set.secret_key_share(0).decrypt_share(ct)
     with pytest.raises(ValueError):
         TpuEngine().combine_decryption_shares_batch([(pk_set, {0: share}, ct)])
+
+
+def test_windowed_ladder_matches_bit_ladder_and_oracle():
+    """w=4 windows vs the 255-bit ladder vs the pure-Python oracle,
+    including the edge scalars 0, 1, R-1."""
+    import random
+
+    rng = random.Random(5)
+    ks = [0, 1, bls.R - 1, rng.randrange(bls.R)]
+    p = bls.multiply(bls.G1, 777)
+    pts_limbs = jnp.asarray(bj.points_to_limbs([p] * len(ks)))
+    wins = jnp.asarray(bj.scalars_to_windows(ks))
+    bits = jnp.asarray(bj.scalars_to_bits(ks))
+    via_windows = bj.limbs_to_points(
+        bj.jac_scalar_mul_windowed(pts_limbs, wins)
+    )
+    via_bits = bj.limbs_to_points(bj.jac_scalar_mul(pts_limbs, bits))
+    for k, a, b in zip(ks, via_windows, via_bits):
+        expected = bls.multiply(p, k)
+        assert bls.eq(a, expected)
+        assert bls.eq(b, expected)

@@ -533,6 +533,18 @@ def pairing_check_eq(p1, q1, p2, q2) -> bool:
     return final_exponentiation(f) == FQ12.one()
 
 
+def pairing_product_check(pairs) -> bool:
+    """Π e(p_i ∈ G1, q_i ∈ G2) == 1 with a single final exponentiation.
+
+    The batch-verification primitive: n+1 Miller loops + one final exp
+    replace the 2 Miller loops + final exp *per signature* of the naive
+    loop (see engine.CpuEngine.verify_batch)."""
+    f = FQ12.one()
+    for p, q in pairs:
+        f = f * miller_loop(twist(q), cast_g1_to_fq12(p))
+    return final_exponentiation(f) == FQ12.one()
+
+
 # ---------------------------------------------------------------------------
 # Hashing / serialization
 # ---------------------------------------------------------------------------
@@ -612,6 +624,12 @@ def g1_from_bytes(raw: bytes):
     pt = (x, y, FQ(1))
     if not is_on_curve(pt, B1):
         raise ValueError("point not on curve")
+    if not is_inf(multiply(pt, R)):
+        # on the curve but outside the r-order subgroup: a cofactor
+        # component would defeat batch verification's soundness (an
+        # attacker-added small-order term vanishes whenever the random
+        # coefficient is divisible by its order)
+        raise ValueError("G1 point not in the r-order subgroup")
     return pt
 
 
@@ -653,4 +671,9 @@ def g2_from_bytes(raw: bytes):
     pt = (x, y, FQ2.one())
     if not is_on_curve(pt, B2):
         raise ValueError("point not on curve")
+    if not is_inf(multiply(pt, R)):
+        # E'(Fp2) has cofactor h2 with small prime factors (13^2, 23^2,
+        # ...): without this check a mauled signature sig+T (ord(T)=13)
+        # passes batch verification with probability ~1/13
+        raise ValueError("G2 point not in the r-order subgroup")
     return pt

@@ -56,6 +56,11 @@ class SimMetrics:
     bytes_committed: int = 0
     agreement_ok: bool = True
     faults: int = 0
+    # per-epoch wall-time percentiles, ms (SURVEY.md §5.5: batch latency
+    # as a first-class sim output; the reference only logs)
+    latency_p50_ms: float = 0.0
+    latency_p90_ms: float = 0.0
+    latency_p99_ms: float = 0.0
 
     @property
     def epochs_per_sec(self) -> float:
@@ -83,6 +88,9 @@ class SimMetrics:
             "bytes_committed": self.bytes_committed,
             "agreement_ok": self.agreement_ok,
             "faults": self.faults,
+            "latency_p50_ms": round(self.latency_p50_ms, 3),
+            "latency_p90_ms": round(self.latency_p90_ms, 3),
+            "latency_p99_ms": round(self.latency_p99_ms, 3),
         }
 
 
@@ -151,6 +159,14 @@ class SimNetwork:
         )
         self._txn_counter = 0
         self.total_wall_s = 0.0  # cumulative across run() calls / resumes
+        self.epoch_durations: List[float] = []  # seconds, per run_epoch
+
+    def __setstate__(self, state):
+        """Unpickle (checkpoint resume): default attributes added after a
+        checkpoint was written, so older snapshots keep loading."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("total_wall_s", 0.0)
+        self.__dict__.setdefault("epoch_durations", [])
 
     def _handle(self, me, sender, message):
         return self.nodes[me].handle_message(sender, message)
@@ -163,6 +179,7 @@ class SimNetwork:
 
     def run_epoch(self) -> None:
         """Generate workload, propose everywhere, run to quiescence."""
+        t0 = time.perf_counter()
         cfg = self.cfg
         if cfg.protocol == "qhb":
             for nid in self.ids:
@@ -184,6 +201,7 @@ class SimNetwork:
                         nid, node.propose(payload, self.rng)
                     )
         self.router.run()
+        self.epoch_durations.append(time.perf_counter() - t0)
 
     def run(self, epochs: Optional[int] = None) -> SimMetrics:
         """Run `epochs` more epochs; metrics are lifetime-cumulative (all
@@ -199,6 +217,16 @@ class SimNetwork:
         m.faults = len(self.router.faults)
         m.epochs_done = min(len(self._batches(nid)) for nid in self.ids)
         m.agreement_ok = self._check_agreement()
+        if self.epoch_durations:
+            ordered = sorted(self.epoch_durations)
+
+            def pct(q: float) -> float:
+                idx = min(len(ordered) - 1, int(q * len(ordered)))
+                return ordered[idx] * 1000.0
+
+            m.latency_p50_ms = pct(0.50)
+            m.latency_p90_ms = pct(0.90)
+            m.latency_p99_ms = pct(0.99)
         for batch in self._batches(self.ids[0]):
             for _, txns in sorted(batch.contributions.items()):
                 if isinstance(txns, (list, tuple)):

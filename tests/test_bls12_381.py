@@ -79,3 +79,37 @@ def test_point_serialization():
     assert b.is_inf(b.g2_from_bytes(b.g2_to_bytes(b.infinity(b.FQ2))))
     with pytest.raises(ValueError):
         b.g1_from_bytes(b"\x00" * 47)
+
+
+def test_deserialization_rejects_non_subgroup_points():
+    """On-curve points outside the r-order subgroup must be rejected:
+    E'(Fp2)'s cofactor has small prime factors (13^2, 23^2, ...), and a
+    small-order component added to a signature defeats batch
+    verification with probability ~1/order (engine.verify_batch)."""
+    import random
+
+    rng = random.Random(3)
+    small_order = None
+    for _ in range(60):
+        c0 = rng.randrange(b.P)
+        c1 = rng.randrange(b.P)
+        x = b.FQ2([c0, c1])
+        y = (x * x * x + b.B2).sqrt()
+        if y is None:
+            continue
+        # the 13-Sylow subgroup is Z13 x Z13: exponent 13, order 169
+        cand = b.multiply(
+            (x, y, b.FQ2.one()), (b.H2_COFACTOR * b.R) // 169
+        )
+        if not b.is_inf(cand):
+            assert b.is_inf(b.multiply(cand, 13))
+            small_order = cand
+            break
+    assert small_order is not None, "no small-order point found"
+    with pytest.raises(ValueError, match="subgroup"):
+        b.g2_from_bytes(b.g2_to_bytes(small_order))
+    # legitimate points still round-trip through both codecs
+    sig = b.multiply(b.hash_to_g2(b"m"), 42)
+    assert b.eq(b.g2_from_bytes(b.g2_to_bytes(sig)), sig)
+    g1pt = b.multiply(b.G1, 99)
+    assert b.eq(b.g1_from_bytes(b.g1_to_bytes(g1pt)), g1pt)

@@ -1,0 +1,104 @@
+"""Crypto micro-batching: engine.verify_batch (random-linear-combination
+batch BLS verification) and the async CryptoBridge collector
+(SURVEY.md §7 hard part 3)."""
+import asyncio
+import random
+
+import pytest
+
+from hydrabadger_tpu.crypto import threshold as th
+from hydrabadger_tpu.crypto.engine import CpuEngine
+from hydrabadger_tpu.net.bridge import CryptoBridge
+
+
+def _signed_items(n, seed=0):
+    rng = random.Random(seed)
+    items = []
+    for i in range(n):
+        sk = th.SecretKey.random(rng)
+        msg = b"frame-%d" % i
+        items.append((sk.public_key(), sk.sign(msg), msg))
+    return items
+
+
+class TestVerifyBatch:
+    def test_all_valid(self):
+        items = _signed_items(5)
+        assert CpuEngine().verify_batch(items) == [True] * 5
+
+    def test_pinpoints_invalid(self):
+        items = _signed_items(5)
+        # swap two signatures: both become invalid, others stay valid
+        bad = list(items)
+        bad[1] = (items[1][0], items[3][1], items[1][2])
+        bad[3] = (items[3][0], items[1][1], items[3][2])
+        assert CpuEngine().verify_batch(bad) == [True, False, True, False, True]
+
+    def test_duplicate_messages_and_keys(self):
+        rng = random.Random(9)
+        sk = th.SecretKey.random(rng)
+        msg = b"same"
+        item = (sk.public_key(), sk.sign(msg), msg)
+        assert CpuEngine().verify_batch([item] * 4) == [True] * 4
+
+    def test_empty_and_single(self):
+        assert CpuEngine().verify_batch([]) == []
+        items = _signed_items(1)
+        assert CpuEngine().verify_batch(items) == [True]
+
+
+class TestCryptoBridge:
+    def test_batches_concurrent_requests(self):
+        items = _signed_items(6, seed=3)
+        bad_sig = items[1][1]
+        requests = items[:1] + [(items[1][0], bad_sig, b"tampered")] + items[2:]
+
+        async def run():
+            bridge = CryptoBridge(max_delay_ms=5.0)
+            bridge.start()
+            results = await asyncio.gather(
+                *[bridge.verify(pk, sig, msg) for pk, sig, msg in requests]
+            )
+            await bridge.stop()
+            return results, bridge
+
+        results, bridge = asyncio.run(run())
+        assert results == [True, False, True, True, True, True]
+        assert bridge.requests_served == 6
+        # the 5 ms straggler window must have coalesced the gather into
+        # far fewer engine dispatches than requests
+        assert bridge.batches_dispatched < 6
+
+    def test_decrypt_share_batched(self):
+        rng = random.Random(4)
+        sk_set = th.SecretKeySet.random(1, rng)
+        pk = sk_set.public_keys().public_key()
+        ct = pk.encrypt(b"secret padding..", rng)
+        shares = [sk_set.secret_key_share(i) for i in range(3)]
+
+        async def run():
+            bridge = CryptoBridge(max_delay_ms=5.0)
+            bridge.start()
+            out = await asyncio.gather(
+                *[bridge.decrypt_share(s, ct) for s in shares]
+            )
+            await bridge.stop()
+            return out
+
+        out = asyncio.run(run())
+        for i, share in enumerate(out):
+            assert shares[i].decrypt_share(ct) == share
+
+    def test_stop_cancels_pending(self):
+        async def run():
+            bridge = CryptoBridge(max_delay_ms=1000.0)  # huge window
+            bridge.start()
+            items = _signed_items(1, seed=7)
+            fut = asyncio.ensure_future(bridge.verify(*items[0]))
+            await asyncio.sleep(0.01)
+            await bridge.stop()
+            await asyncio.sleep(0)
+            return fut
+
+        fut = asyncio.run(run())
+        assert fut.cancelled() or fut.done()

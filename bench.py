@@ -29,22 +29,26 @@ B, L = 1024, 256
 EPOCHS_PER_DISPATCH = 50
 
 
-def _cpu_engine_throughput() -> float:
-    """Per-instance encode loop (native C++ GF kernel if built)."""
+def _loop_encode_sps(k: int, p: int, data: np.ndarray) -> float:
+    """Per-instance CPU encode loop (native C++ GF kernel if built),
+    sampled and extrapolated (the loop is steady-state). -> shards/s"""
     from hydrabadger_tpu.crypto.rs import ReedSolomon
 
-    rs = ReedSolomon(K, P)
-    rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, (B, K, L)).astype(np.uint8)
-    # warm-up + measure a slice, extrapolate (the loop is steady-state)
-    sample = min(B, 128)
+    rs = ReedSolomon(k, p)
+    sample = min(data.shape[0], 128)
     for i in range(4):
         rs.encode(data[i])
     t0 = time.perf_counter()
     for i in range(sample):
         rs.encode(data[i])
     dt = time.perf_counter() - t0
-    return sample * N_SHARDS / dt  # shards/sec
+    return sample * (k + p) / dt
+
+
+def _cpu_engine_throughput() -> float:
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (B, K, L)).astype(np.uint8)
+    return _loop_encode_sps(K, P, data)
 
 
 def _sync(x) -> None:
@@ -59,40 +63,46 @@ def _sync(x) -> None:
     jax.device_get(x.reshape(-1)[:1])
 
 
-def _tpu_throughput() -> tuple[float, str]:
-    """Steady-state epochs: scan EPOCHS_PER_DISPATCH encodes inside one
-    device call, each consuming the previous epoch's parity — the
-    framework's operating mode (batch across instances x epochs,
-    SURVEY.md §2.3), and the only honest measurement through a remote
-    dispatch path with ~10 ms per-call latency."""
+def _scan_encode_sps(k: int, p: int, data: np.ndarray, reps: int) -> float:
+    """Steady-state device encode: scan `reps` epochs inside ONE dispatch,
+    each consuming the previous epoch's parity (data-dependent, so the
+    scan cannot be elided) — the framework's operating mode (batch
+    across instances x epochs, SURVEY.md §2.3), and the only honest
+    measurement through a remote dispatch path with ~10 ms per-call
+    latency. -> shards/s"""
     from functools import partial
 
     import jax
-    import jax.numpy as jnp
     from jax import lax
 
     from hydrabadger_tpu.ops import rs_jax
 
-    backend = jax.default_backend()
-    rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, (B, K, L)).astype(np.uint8)
+    B_, _k, _L = data.shape
     dev = jax.device_put(data)
 
-    @partial(jax.jit, static_argnames=("epochs",))
-    def run_epochs(data, epochs):
+    @partial(jax.jit, static_argnames=("reps",))
+    def run_reps(d, reps):
         def body(carry, _):
-            out = rs_jax.rs_encode_batch(carry, K, P)
-            # next epoch proposes the parity (data-dependent: not elidable)
-            return out[:, P : P + K, :], out[0, K, 0]
-        final, _ = lax.scan(body, data, None, length=epochs)
+            out = rs_jax.rs_encode_batch(carry, k, p)
+            return out[:, p : p + k, :], out[0, k, 0]
+        final, _ = lax.scan(body, d, None, length=reps)
         return final
 
-    _sync(run_epochs(dev, EPOCHS_PER_DISPATCH))  # compile + warm
+    _sync(run_reps(dev, reps))  # compile + warm
     t0 = time.perf_counter()
-    out = run_epochs(dev, EPOCHS_PER_DISPATCH)
-    _sync(out)
-    dt = (time.perf_counter() - t0) / EPOCHS_PER_DISPATCH
-    return B * N_SHARDS / dt, backend
+    _sync(run_reps(dev, reps))
+    dt = (time.perf_counter() - t0) / reps
+    return B_ * (k + p) / dt
+
+
+def _tpu_throughput() -> tuple[float, str]:
+    import jax
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (B, K, L)).astype(np.uint8)
+    return _scan_encode_sps(K, P, data, EPOCHS_PER_DISPATCH), (
+        jax.default_backend()
+    )
 
 
 def _bls_threshold_decrypt_config4(epochs: int) -> dict:
@@ -153,6 +163,138 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
     }
 
 
+def _tcp_testnet_config1(epochs: int) -> dict:
+    """BASELINE.json config 1: 4-node local testnet, default (full) crypto
+    tier — threshold-encrypted contributions, threshold common coin,
+    share verification, BLS-signed wire frames — run in-process on
+    localhost sockets until every node commits `epochs` batches.
+
+    This is the reference's ./run-node 0..3 flow (README.md:12-25) as a
+    measurable benchmark instead of "watch the logs"."""
+    import asyncio
+
+    from hydrabadger_tpu.net.node import Config, Hydrabadger
+    from hydrabadger_tpu.utils.ids import InAddr, OutAddr
+
+    n, base = 4, 3650
+
+    async def run():
+        cfg = Config(
+            txn_gen_interval_ms=300,
+            keygen_peer_count=n - 1,
+        )
+        nodes = [
+            Hydrabadger(InAddr("127.0.0.1", base + i), cfg, seed=1000 + i)
+            for i in range(n)
+        ]
+        gen = lambda count, size: [b"%02dx" % i * size for i in range(count)]
+        for i, node in enumerate(nodes):
+            remotes = [
+                OutAddr("127.0.0.1", base + j) for j in range(n) if j != i
+            ]
+            await node.start(remotes, gen)
+        t0 = time.perf_counter()
+        while min(len(node.batches) for node in nodes) < epochs:
+            await asyncio.sleep(0.2)
+        dt = time.perf_counter() - t0
+        for node in nodes:
+            await node.stop()
+        return epochs / dt
+
+    eps = asyncio.run(run())
+    return {
+        "metric": f"tcp_testnet_epochs_per_sec_4node_full_crypto",
+        "value": round(eps, 4),
+        "unit": "epochs/s",
+        "vs_baseline": 1.0,  # this IS the reference-parity flow
+    }
+
+
+def _sim16_config2(epochs: int) -> dict:
+    """BASELINE.json config 2: 16-node in-process sim, QueueingHoneyBadger,
+    CPU CryptoEngine — the minimum end-to-end slice (SURVEY.md §7 M2) and
+    the CPU anchor the TPU configs are measured against."""
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    net = SimNetwork(SimConfig(n_nodes=16, protocol="qhb", seed=0))
+    m = net.run(epochs)
+    assert m.agreement_ok
+    return {
+        "metric": "sim_epochs_per_sec_16node_cpu",
+        "value": round(m.epochs_per_sec, 3),
+        "unit": "epochs/s",
+        "vs_baseline": 1.0,  # the CPU baseline itself
+    }
+
+
+def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
+    """BASELINE.json config 5: DynamicHoneyBadger with validator churn and
+    4096-txn epochs.
+
+    A removal vote is injected at epoch 1; the run asserts the change
+    commits, the era switches, and the surviving validators keep
+    committing identical batches.  The full 128-node logic tier is a
+    soak run (an epoch is O(N^3) Python messages and the era-switch DKG
+    is O(N^2) acks of pure-Python G1 ops), so the default scales to 8
+    nodes; `vs_baseline` reports the TPU/CPU
+    shard-throughput ratio of this topology's Reed-Solomon geometry at
+    4096 concurrent instances — the part of config 5 the TPU executes.
+    """
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    txns_per_node = max(1, 4096 // n_nodes)
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=n_nodes,
+            protocol="dhb",
+            txns_per_node_per_epoch=txns_per_node,
+            txn_bytes=2,
+            seed=0,
+        )
+    )
+    net.run(1)
+    victim = net.ids[-1]
+    for nid in net.ids:
+        if nid != victim:
+            net.router.dispatch_step(
+                nid, net.nodes[nid].vote_to_remove(victim)
+            )
+    m = None
+    for _ in range(8):
+        m = net.run(1)
+        if all(
+            net.nodes[nid].era > 0 for nid in net.ids if nid != victim
+        ):
+            break
+    assert m is not None and m.agreement_ok
+    survivors = [nid for nid in net.ids if nid != victim]
+    assert all(net.nodes[nid].era > 0 for nid in survivors), "era switch"
+    assert all(
+        victim not in net.nodes[nid].netinfo.node_ids for nid in survivors
+    )
+    m = net.run(max(1, epochs - len(net.epoch_durations)))
+    assert m.agreement_ok
+
+    # the TPU leg: this topology's broadcast shard geometry, 4096
+    # instances, steady-state vs the per-instance CPU loop
+    f = (n_nodes - 1) // 3
+    k, p_sh = n_nodes - 2 * f, 2 * f
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (4096, k, 256)).astype(np.uint8)
+    tpu_sps = _scan_encode_sps(k, p_sh, data, reps=20)
+    cpu_sps = _loop_encode_sps(k, p_sh, data)
+
+    return {
+        "metric": (
+            f"dhb_churn_epochs_per_sec_{n_nodes}node_"
+            f"{txns_per_node * n_nodes}txn"
+        ),
+        "value": round(m.epochs_per_sec, 4),
+        "unit": "epochs/s",
+        "vs_baseline": round(tpu_sps / cpu_sps, 2),
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -160,23 +302,46 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[3, 4],
+        choices=[1, 2, 3, 4, 5],
         default=3,
-        help="BASELINE.json config: 3 = RS-on-TPU (default, the driver's "
-        "headline line), 4 = batched BLS ThresholdDecrypt",
+        help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
+        "2 = 16-node sim CPU, 3 = RS-on-TPU (default, the driver's "
+        "headline line), 4 = batched BLS ThresholdDecrypt, 5 = DHB "
+        "validator churn + TPU RS at that topology",
     )
     p.add_argument(
         "--epochs",
         type=int,
-        default=1024,
-        help="concurrent epochs for config 4",
+        default=None,
+        help="concurrent epochs (config 4, default 1024) / committed "
+        "epochs (config 1 default 2, config 2 default 20, config 5 "
+        "default 8)",
+    )
+    p.add_argument(
+        "--nodes",
+        type=int,
+        default=8,
+        help="config 5 topology size (128 = full BASELINE soak, hours; "
+        "an epoch is O(N^3) Python messages on the logic tier)",
     )
     args = p.parse_args(argv)
-    if args.epochs < 1:
+    if args.epochs is not None and args.epochs < 1:
         p.error("--epochs must be >= 1")
 
+    def epochs_or(default: int) -> int:
+        return default if args.epochs is None else args.epochs
+
+    if args.config == 1:
+        print(json.dumps(_tcp_testnet_config1(epochs_or(2))))
+        return 0
+    if args.config == 2:
+        print(json.dumps(_sim16_config2(epochs_or(20))))
+        return 0
+    if args.config == 5:
+        print(json.dumps(_dhb_churn_config5(args.nodes, epochs_or(8))))
+        return 0
     if args.config == 4:
-        print(json.dumps(_bls_threshold_decrypt_config4(args.epochs)))
+        print(json.dumps(_bls_threshold_decrypt_config4(epochs_or(1024))))
         return 0
 
     cpu_sps = _cpu_engine_throughput()

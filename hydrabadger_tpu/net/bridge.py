@@ -80,6 +80,8 @@ class CryptoBridge:
     # -- request API ----------------------------------------------------------
 
     def _submit(self, kind: str, args) -> asyncio.Future:
+        if self._closed:
+            raise RuntimeError("CryptoBridge is stopped")
         if self._task is None:
             self.start()
         fut = asyncio.get_running_loop().create_future()
@@ -119,6 +121,14 @@ class CryptoBridge:
                     results = await asyncio.get_running_loop().run_in_executor(
                         None, self._dispatch, kind, args_list
                     )
+                except asyncio.CancelledError:
+                    # stop() mid-dispatch: the whole drained batch already
+                    # left _pending, so cancel every future in it (not
+                    # just this kind's) or their awaiters hang forever
+                    for _kind, _args, fut in batch:
+                        if not fut.done():
+                            fut.cancel()
+                    raise
                 except Exception as exc:  # engine blew up: fail the batch
                     for _a, fut in reqs:
                         if not fut.done():

@@ -45,6 +45,9 @@ class SimConfig:
     seed: int = 0
     shuffle: bool = True
     adversary: Optional[Callable] = None
+    # router quiescence budget per epoch; None = auto (the message
+    # complexity of an epoch is O(N^3): N broadcast instances x O(N^2))
+    max_messages_per_epoch: Optional[int] = None
 
 
 @dataclass
@@ -200,7 +203,10 @@ class SimNetwork:
                     self.router.dispatch_step(
                         nid, node.propose(payload, self.rng)
                     )
-        self.router.run()
+        budget = self.cfg.max_messages_per_epoch or max(
+            1_000_000, 60 * self.cfg.n_nodes**3
+        )
+        self.router.run(budget)
         self.epoch_durations.append(time.perf_counter() - t0)
 
     def run(self, epochs: Optional[int] = None) -> SimMetrics:

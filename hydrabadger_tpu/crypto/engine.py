@@ -224,6 +224,37 @@ class CpuEngine:
     ) -> th.Signature:
         return pk_set.combine_signatures(shares)
 
+    def sign_share_batch(
+        self, items: Sequence[Tuple[th.SecretKeyShare, bytes]]
+    ) -> List[th.SignatureShare]:
+        """Batched sk_i * H(m) across (node, epoch) coin rounds.  The CPU
+        baseline is the per-node loop inside hbbft::threshold_sign (one
+        hash per distinct msg; sign_share re-hashes internally so we
+        multiply directly); the TPU engine runs every share as one lane
+        of the G2 ladder."""
+        from .bls12_381 import multiply
+
+        h_cache: Dict[bytes, tuple] = {}
+        return [
+            th.SignatureShare(
+                multiply(
+                    h_cache.setdefault(msg, th.hash_to_g2(msg)), sk.scalar
+                )
+            )
+            for sk, msg in items
+        ]
+
+    def combine_signature_shares_batch(
+        self,
+        jobs: Sequence[
+            Tuple[th.PublicKeySet, Mapping[int, th.SignatureShare]]
+        ],
+    ) -> List[th.Signature]:
+        """Batched Lagrange combine in the exponent over G2."""
+        return [
+            pk_set.combine_signatures(shares) for pk_set, shares in jobs
+        ]
+
     def __repr__(self) -> str:
         return f"<{type(self).__name__}>"
 
@@ -274,41 +305,90 @@ class TpuEngine(CpuEngine):
 
         return bls_jax.g1_scalar_mul_batch(points, scalars)
 
+    def sign_share_batch(
+        self, items: Sequence[Tuple[th.SecretKeyShare, bytes]]
+    ) -> List[th.SignatureShare]:
+        if not items:
+            return []
+        from ..ops import bls_g2_jax
+
+        # a coin batch repeats one msg across all nodes: hash each
+        # distinct msg once (hash_to_g2 is pure-Python and expensive)
+        h_cache: Dict[bytes, tuple] = {}
+        points = bls_g2_jax.g2_scalar_mul_batch(
+            [
+                h_cache.setdefault(msg, th.hash_to_g2(msg))
+                for _sk, msg in items
+            ],
+            [sk.scalar for sk, _msg in items],
+        )
+        return [th.SignatureShare(p) for p in points]
+
+    def combine_signature_shares_batch(
+        self,
+        jobs: Sequence[
+            Tuple[th.PublicKeySet, Mapping[int, th.SignatureShare]]
+        ],
+    ) -> List[th.Signature]:
+        """One G2 weighted-sum launch per quorum size S (as with
+        decryption combines, a steady-state sim shares one S)."""
+        if not jobs:
+            return []
+        from ..ops import bls_g2_jax
+
+        prepared, by_size = self._quorum_prep(
+            [(pk_set.threshold, shares) for pk_set, shares in jobs]
+        )
+        out: List[Optional[th.Signature]] = [None] * len(jobs)
+        for idxs in by_size.values():
+            combined = bls_g2_jax.g2_weighted_sum_batch(
+                [prepared[i][0] for i in idxs],
+                [prepared[i][1] for i in idxs],
+            )
+            for i, g in zip(idxs, combined):
+                out[i] = th.Signature(g)
+        return out  # type: ignore[return-value]
+
+    @staticmethod
+    def _quorum_prep(jobs_shares):
+        """Shared combine scaffold: pick the lowest t+1 share ids per job,
+        compute Lagrange-at-zero coefficients, and group job indices by
+        quorum size (the combine tensor is [B, S, ...], so one kernel
+        launch per S; a steady-state sim shares one S)."""
+        by_size: Dict[int, List[int]] = {}
+        prepared = []
+        for idx, (threshold, shares) in enumerate(jobs_shares):
+            if len(shares) <= threshold:
+                raise ValueError(
+                    f"need {threshold + 1} shares, got {len(shares)}"
+                )
+            ids = sorted(shares)[: threshold + 1]
+            lam = th.lagrange_coeffs_at_zero([i + 1 for i in ids])
+            prepared.append(([shares[i].point for i in ids], lam))
+            by_size.setdefault(len(ids), []).append(idx)
+        return prepared, by_size
+
     def combine_decryption_shares_batch(
         self,
         jobs: Sequence[
             Tuple[th.PublicKeySet, Mapping[int, th.DecryptionShare], th.Ciphertext]
         ],
     ) -> List[bytes]:
-        """One weighted-sum kernel launch per quorum size S.
-
-        Jobs are grouped by S because the combine tensor is [B, S, ...];
-        in a steady-state sim every instance shares the same S, so this
-        is one launch."""
+        """One weighted-sum kernel launch per quorum size S."""
         if not jobs:
             return []
         from ..ops import bls_jax
 
-        by_size: Dict[int, List[int]] = {}
-        prepared = []
-        for idx, (pk_set, shares, ct) in enumerate(jobs):
-            if len(shares) <= pk_set.threshold:
-                raise ValueError(
-                    f"need {pk_set.threshold + 1} shares, got {len(shares)}"
-                )
-            ids = sorted(shares)[: pk_set.threshold + 1]
-            xs = [i + 1 for i in ids]
-            lam = th.lagrange_coeffs_at_zero(xs)
-            pts = [shares[i].point for i in ids]
-            prepared.append((pts, lam, ct))
-            by_size.setdefault(len(ids), []).append(idx)
+        prepared, by_size = self._quorum_prep(
+            [(pk_set.threshold, shares) for pk_set, shares, _ct in jobs]
+        )
         out: List[Optional[bytes]] = [None] * len(jobs)
-        for size, idxs in by_size.items():
+        for idxs in by_size.values():
             combined = bls_jax.g1_weighted_sum_batch(
                 [prepared[i][0] for i in idxs], [prepared[i][1] for i in idxs]
             )
             for i, g in zip(idxs, combined):
-                out[i] = th.unwrap_ciphertext(g, prepared[i][2])
+                out[i] = th.unwrap_ciphertext(g, jobs[i][2])
         return out  # type: ignore[return-value]
 
 _REGISTRY: Dict[str, type] = {"cpu": CpuEngine, "tpu": TpuEngine}

@@ -1,0 +1,310 @@
+"""Batched BLS12-381 G2 arithmetic on TPU — ThresholdSign / common coin.
+
+Signatures live in G2 in this scheme (crypto/threshold.py: `sign` is
+`sk * hash_to_g2(msg)`), so the per-epoch common-coin work every node
+performs — a signature share per (node, epoch) and a Lagrange combine
+per epoch (reference: hbbft::threshold_sign reached via
+/root/reference/src/hydrabadger/state.rs:487) — is G2 group math.  This
+module extends the limb-tensor design of ops/bls_jax.py to Fp2:
+
+  - An Fp2 element is `[..., 2, 32]`: two 32x12-bit-limb Fp vectors
+    (c0 + c1*u, u^2 = -1).  All Fp primitives (Montgomery convolution
+    multiply, carry scans) are reused from bls_jax over the extra
+    leading axis; fq2_mul is the 3-multiplication Karatsuba.
+  - G2 points are Jacobian `[..., 3, 2, 32]` over the twist
+    y^2 = x^3 + 4(u+1), Z == 0 at infinity, branch-free add/double, and
+    the same windowed (w=4) ladder as G1.
+
+Bit-exact vs the pure-Python oracle (tests/test_bls_g2_jax.py);
+crypto/engine.TpuEngine routes sign_share_batch /
+combine_signature_shares_batch here.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bls12_381 as bls
+from ..crypto.bls12_381 import FQ2, P
+from . import bls_jax as bj
+from .bls_jax import (
+    N_LIMBS,
+    R_MONT,
+    fq_add,
+    fq_mul,
+    fq_sub,
+    scalars_to_windows,
+)
+
+# ---------------------------------------------------------------------------
+# Fp2 primitives over [..., 2, 32] limb tensors
+# ---------------------------------------------------------------------------
+
+
+def fq2_add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return fq_add(a, b)  # componentwise; fq ops batch over leading axes
+
+
+def fq2_sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    return fq_sub(a, b)
+
+
+def fq2_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(a0 + a1 u)(b0 + b1 u), u^2 = -1 — Karatsuba, 3 fq_muls."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = fq_mul(a0, b0)
+    t1 = fq_mul(a1, b1)
+    c0 = fq_sub(t0, t1)
+    cross = fq_mul(fq_add(a0, a1), fq_add(b0, b1))
+    c1 = fq_sub(fq_sub(cross, t0), t1)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_is_zero(a: jax.Array) -> jax.Array:
+    return jnp.all(a == 0, axis=(-2, -1))
+
+
+def _fq2_const(c0: int, c1: int) -> np.ndarray:
+    """Host Fp2 constant in the Montgomery domain -> [2, 32] int32."""
+    rp = R_MONT % P
+    return np.stack(
+        [bj.int_to_limbs(c0 * rp % P), bj.int_to_limbs(c1 * rp % P)]
+    )
+
+
+FQ2_ONE_MONT = _fq2_const(1, 0)
+
+
+# ---------------------------------------------------------------------------
+# Jacobian G2 over the twist (b' = 4(u+1)): [..., 3, 2, 32]
+# ---------------------------------------------------------------------------
+
+
+def g2_infinity(batch_shape=()) -> jax.Array:
+    one = jnp.asarray(FQ2_ONE_MONT)
+    pt = jnp.stack([one, one, jnp.zeros_like(one)])
+    return jnp.broadcast_to(pt, tuple(batch_shape) + (3, 2, N_LIMBS))
+
+
+def g2_is_inf(pt: jax.Array) -> jax.Array:
+    return fq2_is_zero(pt[..., 2, :, :])
+
+
+def g2_double(pt: jax.Array) -> jax.Array:
+    """2P, a=0 Jacobian doubling (handles inf via Z3 = 2YZ = 0)."""
+    x, y, z = pt[..., 0, :, :], pt[..., 1, :, :], pt[..., 2, :, :]
+    a = fq2_mul(x, x)
+    b = fq2_mul(y, y)
+    c = fq2_mul(b, b)
+    t = fq2_add(x, b)
+    d = fq2_sub(fq2_sub(fq2_mul(t, t), a), c)
+    d = fq2_add(d, d)
+    e = fq2_add(fq2_add(a, a), a)
+    f = fq2_mul(e, e)
+    x3 = fq2_sub(f, fq2_add(d, d))
+    c8 = fq2_add(c, c)
+    c8 = fq2_add(c8, c8)
+    c8 = fq2_add(c8, c8)
+    y3 = fq2_sub(fq2_mul(e, fq2_sub(d, x3)), c8)
+    yz = fq2_mul(y, z)
+    z3 = fq2_add(yz, yz)
+    return jnp.stack([x3, y3, z3], axis=-3)
+
+
+def g2_add(p1: jax.Array, p2: jax.Array) -> jax.Array:
+    """P1 + P2, branch-free: inf and P1==P2 cases resolved with masks."""
+    x1, y1, z1 = p1[..., 0, :, :], p1[..., 1, :, :], p1[..., 2, :, :]
+    x2, y2, z2 = p2[..., 0, :, :], p2[..., 1, :, :], p2[..., 2, :, :]
+    z1z1 = fq2_mul(z1, z1)
+    z2z2 = fq2_mul(z2, z2)
+    u1 = fq2_mul(x1, z2z2)
+    u2 = fq2_mul(x2, z1z1)
+    s1 = fq2_mul(fq2_mul(y1, z2), z2z2)
+    s2 = fq2_mul(fq2_mul(y2, z1), z1z1)
+    h = fq2_sub(u2, u1)
+    r = fq2_sub(s2, s1)
+    hh = fq2_mul(h, h)
+    hhh = fq2_mul(h, hh)
+    v = fq2_mul(u1, hh)
+    rr = fq2_mul(r, r)
+    x3 = fq2_sub(fq2_sub(rr, hhh), fq2_add(v, v))
+    y3 = fq2_sub(fq2_mul(r, fq2_sub(v, x3)), fq2_mul(s1, hhh))
+    z3 = fq2_mul(fq2_mul(z1, z2), h)
+    general = jnp.stack([x3, y3, z3], axis=-3)
+
+    inf1 = g2_is_inf(p1)[..., None, None, None]
+    inf2 = g2_is_inf(p2)[..., None, None, None]
+    h_zero = fq2_is_zero(h)[..., None, None, None]
+    r_zero = fq2_is_zero(r)[..., None, None, None]
+
+    res = jnp.where(h_zero & r_zero, g2_double(p1), general)
+    res = jnp.where(inf2, p1, res)
+    res = jnp.where(inf1, p2, res)
+    return res
+
+
+@jax.jit
+def g2_scalar_mul_windowed(points: jax.Array, windows: jax.Array) -> jax.Array:
+    """Fixed-window (w=4) ladder, same shape as bls_jax's G1 ladder.
+
+    points: [..., 3, 2, 32], windows: [..., 64] MSB-first 4-bit digits.
+    """
+    batch = points.shape[:-3]
+
+    def tbl_step(prev, _):
+        nxt = g2_add(prev, points)
+        return nxt, nxt
+
+    _, chain = jax.lax.scan(tbl_step, points, None, length=14)
+    t = jnp.concatenate(
+        [g2_infinity(batch)[None], points[None], chain], axis=0
+    )
+    t = jnp.moveaxis(t, 0, -4)  # [..., 16, 3, 2, 32]
+
+    acc0 = g2_infinity(batch)
+
+    def step(acc, win_col):
+        acc = jax.lax.fori_loop(0, 4, lambda _i, a: g2_double(a), acc)
+        onehot = (
+            win_col[..., None] == jnp.arange(16, dtype=win_col.dtype)
+        ).astype(jnp.int32)
+        sel = jnp.einsum("...t,...tcql->...cql", onehot, t)
+        return g2_add(acc, sel), None
+
+    acc, _ = jax.lax.scan(step, acc0, jnp.moveaxis(windows, -1, 0))
+    return acc
+
+
+@jax.jit
+def g2_weighted_sum_windowed(
+    points: jax.Array, windows: jax.Array
+) -> jax.Array:
+    """sum_s coeff[s] * P[s] per batch row — the Lagrange combine in the
+    exponent for ThresholdSign.  [..., S, 3, 2, 32] x [..., S, 64]."""
+    terms = g2_scalar_mul_windowed(points, windows)
+    s = terms.shape[-4]
+    cols = [terms[..., i, :, :, :] for i in range(s)]
+    while len(cols) > 1:
+        nxt = []
+        for i in range(0, len(cols) - 1, 2):
+            nxt.append(g2_add(cols[i], cols[i + 1]))
+        if len(cols) % 2:
+            nxt.append(cols[-1])
+        cols = nxt
+    return cols[0]
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (CPU FQ2 tuples <-> limb tensors)
+# ---------------------------------------------------------------------------
+
+
+def g2_points_to_limbs(pts: Sequence) -> np.ndarray:
+    """CPU projective G2 points -> [B, 3, 2, 32] Montgomery Jacobian
+    (normalised to Z = 1; infinity -> Z = 0)."""
+    rp = R_MONT % P
+    xs0, xs1, ys0, ys1, zs0, zs1 = [], [], [], [], [], []
+    for pt in pts:
+        aff = bls.normalize(pt)
+        if aff is None:  # infinity
+            xs0.append(rp); xs1.append(0)
+            ys0.append(rp); ys1.append(0)
+            zs0.append(0); zs1.append(0)
+        else:
+            x, y = aff
+            xs0.append(x.coeffs[0] * rp % P)
+            xs1.append(x.coeffs[1] * rp % P)
+            ys0.append(y.coeffs[0] * rp % P)
+            ys1.append(y.coeffs[1] * rp % P)
+            zs0.append(rp); zs1.append(0)
+    limbs = bj.ints_to_limbs_batch(
+        xs0 + xs1 + ys0 + ys1 + zs0 + zs1
+    ).reshape(6, len(pts), N_LIMBS)
+    out = np.stack(
+        [
+            np.stack([limbs[0], limbs[1]], axis=-2),  # X: [B, 2, 32]
+            np.stack([limbs[2], limbs[3]], axis=-2),  # Y
+            np.stack([limbs[4], limbs[5]], axis=-2),  # Z
+        ],
+        axis=1,
+    )  # [B, 3, 2, 32]
+    return np.ascontiguousarray(out)
+
+
+def limbs_to_g2_points(arr) -> list:
+    """[..., 3, 2, 32] Montgomery Jacobian -> flat list of CPU points."""
+    arr = np.asarray(jax.device_get(bj.from_mont(jnp.asarray(arr))))
+    flat = arr.reshape(-1, 3, 2, N_LIMBS)
+    b = flat.shape[0]
+    cols = flat.transpose(1, 2, 0, 3).reshape(6, b, N_LIMBS)
+    ints = [bj.limbs_to_ints_batch(c) for c in cols]
+    x0, x1, y0, y1, z0, z1 = ints
+    zs = [FQ2([a, bb]) for a, bb in zip(z0, z1)]
+    out = []
+    inv_in = [z for z in zs if not z.is_zero()]
+    invs = iter(_fq2_batch_inverse(inv_in))
+    for i in range(b):
+        if zs[i].is_zero():
+            out.append(bls.infinity(FQ2))
+            continue
+        zi = next(invs)
+        zi2 = zi * zi
+        x = FQ2([x0[i], x1[i]]) * zi2
+        y = FQ2([y0[i], y1[i]]) * zi2 * zi
+        out.append((x, y, FQ2.one()))
+    return out
+
+
+def _fq2_batch_inverse(els: Sequence) -> list:
+    """Montgomery's trick over FQ2 (one .inv() per batch)."""
+    if not els:
+        return []
+    prefix = [els[0]]
+    for e in els[1:]:
+        prefix.append(prefix[-1] * e)
+    inv_all = prefix[-1].inv()
+    out = [None] * len(els)
+    for i in range(len(els) - 1, 0, -1):
+        out[i] = inv_all * prefix[i - 1]
+        inv_all = inv_all * els[i]
+    out[0] = inv_all
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched threshold-signature entry points (crypto.engine.TpuEngine)
+# ---------------------------------------------------------------------------
+
+
+def g2_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
+    """Batched sk * H(m) over G2: signature-share generation for a whole
+    batch of (node, epoch) coin rounds at once."""
+    pts = jnp.asarray(g2_points_to_limbs(points))
+    wins = jnp.asarray(scalars_to_windows([s % bls.R for s in scalars]))
+    return limbs_to_g2_points(g2_scalar_mul_windowed(pts, wins))
+
+
+def g2_weighted_sum_batch(
+    points_batch: Sequence[Sequence], coeffs_batch: Sequence[Sequence[int]]
+) -> list:
+    """[B][S] G2 points x [B][S] Fr coeffs -> B combined points: the
+    ThresholdSign Lagrange combine for B epochs at once."""
+    b = len(points_batch)
+    if b == 0:
+        return []
+    s = len(points_batch[0])
+    pts = np.stack([g2_points_to_limbs(row) for row in points_batch])
+    wins = np.stack(
+        [
+            scalars_to_windows([c % bls.R for c in row])
+            for row in coeffs_batch
+        ]
+    )
+    assert pts.shape[:2] == (b, s) and wins.shape[:2] == (b, s)
+    return limbs_to_g2_points(
+        g2_weighted_sum_windowed(jnp.asarray(pts), jnp.asarray(wins))
+    )

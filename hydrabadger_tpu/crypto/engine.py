@@ -235,12 +235,11 @@ class CpuEngine:
         from .bls12_381 import multiply
 
         h_cache: Dict[bytes, tuple] = {}
+        for _sk, msg in items:
+            if msg not in h_cache:  # setdefault would hash eagerly
+                h_cache[msg] = th.hash_to_g2(msg)
         return [
-            th.SignatureShare(
-                multiply(
-                    h_cache.setdefault(msg, th.hash_to_g2(msg)), sk.scalar
-                )
-            )
+            th.SignatureShare(multiply(h_cache[msg], sk.scalar))
             for sk, msg in items
         ]
 
@@ -315,11 +314,11 @@ class TpuEngine(CpuEngine):
         # a coin batch repeats one msg across all nodes: hash each
         # distinct msg once (hash_to_g2 is pure-Python and expensive)
         h_cache: Dict[bytes, tuple] = {}
+        for _sk, msg in items:
+            if msg not in h_cache:  # setdefault would hash eagerly
+                h_cache[msg] = th.hash_to_g2(msg)
         points = bls_g2_jax.g2_scalar_mul_batch(
-            [
-                h_cache.setdefault(msg, th.hash_to_g2(msg))
-                for _sk, msg in items
-            ],
+            [h_cache[msg] for _sk, msg in items],
             [sk.scalar for sk, _msg in items],
         )
         return [th.SignatureShare(p) for p in points]

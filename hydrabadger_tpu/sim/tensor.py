@@ -1,0 +1,172 @@
+"""Device-resident tensor simulator — the north-star batch plane.
+
+BASELINE.json's metric is "HoneyBadger epochs/sec (64 nodes, 256 B
+txns)" batched over 1024+ concurrent instances.  The Python logic tier
+(sim/network.py) steps every message individually — faithful, adversary-
+capable, and O(N^3) Python per epoch.  This module is the other plane
+(SURVEY.md §5.8): the *fault-free fast path* of a HoneyBadger epoch as
+one array program over [instances, nodes, ...] tensors that never
+leaves the device between epochs.
+
+What one fast-path epoch is (and is not): with no faults and timely
+delivery, every Reliable Broadcast completes and every Binary Agreement
+decides 1 in its first round, so the epoch's outcome — every node
+commits the batch of all N proposals — is fully determined by the data
+plane: RS-encode each proposal into N shards, disseminate (each node
+holds shard j of every proposal), reconstruct every proposal from any k
+shards, and concatenate.  That data plane is >99% of the reference's
+per-epoch compute (the crypto walls of SURVEY.md §3.3); the vote
+plumbing it elides is what sim/network.py covers.  Agreement/totality
+are still *checked*, on device, every epoch: each instance's decode is
+compared byte-exact against its proposals.
+
+Shapes (B instances of an N-node network, k data + p parity shards,
+L-byte shards):
+
+    proposals   [B, N, k, L]   uint8   (node i's contribution, sharded)
+    encoded     [B, N, n, L]           one MXU bit-matmul (ops/rs_jax)
+    received    [B, N, n, L]           dissemination = pure transpose
+    decoded     [B, N, k, L]           one bit-matmul from a k-quorum
+    ok          [B]             bool   totality check
+
+Epochs chain through `lax.scan` (the next epoch's proposals derive from
+the previous epoch's parity, so the scan is not elidable), giving
+steady-state epochs/sec in ONE device dispatch — the number `bench.py
+--config 6` reports against a byte-identical CPU fast-path loop.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.rs import ReedSolomon
+from ..ops import rs_jax
+
+
+@dataclass(frozen=True)
+class TensorSimConfig:
+    n_nodes: int = 64
+    instances: int = 1024
+    shard_len: int = 32  # L; payload per node = k * L (256 B at N=64)
+    seed: int = 0
+
+    @property
+    def f(self) -> int:
+        return (self.n_nodes - 1) // 3
+
+    @property
+    def data_shards(self) -> int:
+        return self.n_nodes - 2 * self.f
+
+    @property
+    def parity_shards(self) -> int:
+        return 2 * self.f
+
+
+def _initial_proposals(cfg: TensorSimConfig) -> np.ndarray:
+    rng = np.random.default_rng(cfg.seed)
+    return rng.integers(
+        0,
+        256,
+        (cfg.instances, cfg.n_nodes, cfg.data_shards, cfg.shard_len),
+    ).astype(np.uint8)
+
+
+@partial(jax.jit, static_argnames=("k", "p"))
+def _epoch(proposals: jax.Array, k: int, p: int):
+    """One fast-path epoch for every instance at once.
+
+    proposals: [B, N, k, L] -> (decoded [B, N, k, L], ok [B])
+    """
+    B, N, _k, L = proposals.shape
+    n = k + p
+    # 1. every node RS-encodes its proposal (fold nodes into the batch)
+    encoded = rs_jax.rs_encode_batch(
+        proposals.reshape(B * N, k, L), k, p
+    ).reshape(B, N, n, L)
+    # 2. dissemination: node j ends up holding shard j of every proposal
+    #    — the N^2 Value/Echo traffic is a transpose on device (and an
+    #    all_to_all across a mesh, parallel/mesh.py)
+    received = jnp.swapaxes(encoded, 1, 2)  # [B, n(holder), N(proposer), L]
+    # 3. every node reconstructs every proposal from the first k shards
+    #    it can gather (any k suffice; use holders 0..k-1 == data rows of
+    #    a systematic code, plus a parity quorum check below)
+    quorum = jnp.swapaxes(received[:, :k, :, :], 1, 2)  # [B, N, k, L]
+    # systematic rows ARE the data; also decode from an all-parity-heavy
+    # quorum to exercise the real reconstruction matmul
+    rows = tuple(range(p, n))  # worst case: all parity + tail data rows
+    parity_quorum = jnp.swapaxes(received[:, p:n, :, :], 1, 2)
+    decoded = rs_jax.rs_reconstruct_batch(
+        parity_quorum.reshape(B * N, k, L), rows, k, p
+    ).reshape(B, N, k, L)
+    # 4. totality/agreement: every instance's decode matches its proposals
+    ok = jnp.all(
+        (decoded == proposals).reshape(B, -1) & (quorum == proposals).reshape(B, -1),
+        axis=-1,
+    )
+    return decoded, ok
+
+
+@partial(jax.jit, static_argnames=("k", "p", "epochs"))
+def _run_epochs(proposals: jax.Array, k: int, p: int, epochs: int):
+    """Chain `epochs` fast-path epochs in one dispatch.
+
+    The next epoch's proposals are a byte-rotation of the decode (data-
+    dependent: XLA cannot elide any epoch), mirroring how the reference
+    generates fresh contributions every interval."""
+
+    def body(carry, _):
+        decoded, ok = _epoch(carry, k, p)
+        nxt = jnp.roll(decoded, 1, axis=-1) ^ jnp.uint8(1)
+        return nxt, ok
+
+    final, oks = jax.lax.scan(body, proposals, None, length=epochs)
+    return final, jnp.all(oks)
+
+
+class TensorSim:
+    """B-instance fast-path HoneyBadger network resident on one device."""
+
+    def __init__(self, cfg: Optional[TensorSimConfig] = None):
+        self.cfg = cfg or TensorSimConfig()
+        self._state = jnp.asarray(_initial_proposals(self.cfg))
+
+    def run(self, epochs: int) -> bool:
+        """Run epochs on device; returns the totality verdict (all
+        instances, all epochs).  State stays on device between calls."""
+        cfg = self.cfg
+        self._state, ok = _run_epochs(
+            self._state, cfg.data_shards, cfg.parity_shards, epochs
+        )
+        return bool(ok)
+
+    def committed_bytes_per_epoch(self) -> int:
+        cfg = self.cfg
+        return cfg.instances * cfg.n_nodes * cfg.data_shards * cfg.shard_len
+
+
+def cpu_fast_path_epoch(proposals: np.ndarray, k: int, p: int) -> np.ndarray:
+    """Byte-identical CPU reference for one fast-path epoch: the
+    per-instance, per-node loop the reference runs (C++-backed RS).
+    Used as the bench baseline and the correctness oracle."""
+    B, N, _k, L = proposals.shape
+    n = k + p
+    rs = ReedSolomon(k, p)
+    decoded = np.empty_like(proposals)
+    rows = list(range(p, n))
+    for b in range(B):
+        encoded = np.stack([rs.encode(proposals[b, i]) for i in range(N)])
+        received = np.swapaxes(encoded, 0, 1)
+        parity_quorum = np.swapaxes(received[p:n], 0, 1)  # [N, k, L]
+        for i in range(N):
+            slots: list = [None] * n
+            for j, r in enumerate(rows):
+                slots[r] = parity_quorum[i, j]
+            shards = rs.reconstruct(slots, data_only=True)
+            decoded[b, i] = np.stack(shards[:k])
+    return decoded

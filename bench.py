@@ -313,7 +313,8 @@ def _tensor_epochs_config6(instances: int, epochs: int) -> dict:
     sim = ts.TensorSim(cfg)
     # warm with the SAME epoch count (epochs is a static arg: a different
     # count would recompile inside the timed region)
-    assert sim.run(epochs) is True
+    warm_ok = sim.run(epochs)
+    assert warm_ok
     t0 = time.perf_counter()
     ok = sim.run(epochs)
     dt = time.perf_counter() - t0

@@ -170,6 +170,7 @@ class Hydrabadger:
         self.epoch_listeners: List[asyncio.Queue] = []
         self.current_epoch = self.cfg.start_epoch
         self._internal: asyncio.Queue = asyncio.Queue()
+        self._dialing: set = set()  # OutAddrs with a connect in flight
         self._tasks: List[asyncio.Task] = []
         self._server: Optional[asyncio.base_events.Server] = None
         self._stopped = asyncio.Event()
@@ -265,6 +266,7 @@ class Hydrabadger:
             self._on_incoming, self.bind.host, self.bind.port
         )
         self._tasks.append(asyncio.create_task(self._handler_loop()))
+        self._tasks.append(asyncio.create_task(self._keygen_retry_loop()))
         if gen_txns is not None:
             self._tasks.append(asyncio.create_task(self._generator_loop()))
         for remote in remotes or []:
@@ -308,6 +310,16 @@ class Hydrabadger:
         # run-node script topology) race their listeners; the reference
         # absorbs the same race with its wire retry queue (capped at 10
         # attempts, handler.rs:660-670 / mod.rs:17)
+        if remote in self._dialing:
+            return  # a connect (incl. backoff sleeps) is already running:
+            # a second dial to the same address would storm the registry
+        self._dialing.add(remote)
+        try:
+            await self._connect_outgoing_inner(remote)
+        finally:
+            self._dialing.discard(remote)
+
+    async def _connect_outgoing_inner(self, remote: OutAddr) -> None:
         reader = writer = None
         for attempt in range(10):
             try:
@@ -459,6 +471,7 @@ class Hydrabadger:
             return
         peer.establish(uid, InAddr(str(host), int(port)), pk)
         self.peers.establish(peer)
+        self._replay_parked(peer)
         if self.state == "disconnected":
             self.state = "awaiting_more_peers"
         peer.send(
@@ -478,14 +491,21 @@ class Hydrabadger:
         preverified: Optional[bool] = None,
     ) -> None:
         kind = msg.kind
-        if kind in wire.VERIFIED_KINDS and self.cfg.wire_sign:
-            # by now the handshake frames on this connection have been
-            # handled (FIFO), so the pk is installed — or never will be
-            ok = preverified if preverified is not None \
-                else peer.wire.verify(body, sig)
-            if not ok:
-                log.warning("bad %s signature from %s", kind, peer.out_addr)
+        if kind in wire.VERIFIED_KINDS:
+            if peer.uid is None:
+                # frame raced ahead of this connection's handshake: park
+                # it BEFORE the signature gate (no pk installed yet to
+                # verify against); replay re-enters here with the pk set
+                self._park(peer, msg, body, sig)
                 return
+            if self.cfg.wire_sign:
+                ok = preverified if preverified is not None \
+                    else peer.wire.verify(body, sig)
+                if not ok:
+                    log.warning(
+                        "bad %s signature from %s", kind, peer.out_addr
+                    )
+                    return
         if kind == "welcome_received_change_add":
             uid_b, host, port, pk_b, net_state = msg.payload
             uid = Uid(bytes(uid_b))
@@ -495,6 +515,7 @@ class Hydrabadger:
                     return
                 peer.establish(uid, InAddr(str(host), int(port)), pk)
                 self.peers.establish(peer)
+                self._replay_parked(peer)
             if self.state == "disconnected":
                 self.state = "awaiting_more_peers"
             self._on_net_state(net_state)
@@ -508,6 +529,7 @@ class Hydrabadger:
                     return
                 peer.establish(uid, InAddr(str(host), int(port)), pk)
                 self.peers.establish(peer)
+                self._replay_parked(peer)
                 self._after_peer_established(uid, pk)
             self._on_net_state(net_state)
         elif kind == "hello_request_change_add":
@@ -517,13 +539,13 @@ class Hydrabadger:
             # the claimed source must be the authenticated connection peer
             # (the reference asserts this, peer.rs:158): otherwise any
             # connected peer could impersonate any validator
-            if peer.uid is None or bytes(src_b) != peer.uid.bytes:
+            if bytes(src_b) != peer.uid.bytes:
                 log.warning("message src spoof from %s", peer.out_addr)
                 return
             self._on_consensus_message(bytes(src_b), payload)
         elif kind == "key_gen":
             src_b, instance_id, payload = msg.payload
-            if peer.uid is None or bytes(src_b) != peer.uid.bytes:
+            if bytes(src_b) != peer.uid.bytes:
                 log.warning("key_gen src spoof from %s", peer.out_addr)
                 return
             self._on_key_gen_message(bytes(src_b), tuple(instance_id), payload)
@@ -531,6 +553,20 @@ class Hydrabadger:
             self._on_join_plan(msg.payload)
         elif kind == "net_state_request":
             peer.send(WireMessage("net_state", self._net_state()))
+            # a gossiping peer that belongs to the bootstrap validator
+            # set is a straggler: replay the keygen transcript so it can
+            # close its n^2 ack gate even after we completed.  Joiners
+            # from later eras get the join plan via net_state instead.
+            if self.keygen_outbox and (
+                self.dhb is None
+                or (
+                    self.dhb.era == self.cfg.start_epoch
+                    and peer.uid is not None
+                    and peer.uid.bytes in self.dhb.netinfo.node_ids
+                )
+            ):
+                for kg_msg in self.keygen_outbox:
+                    peer.send(kg_msg)
         elif kind == "net_state":
             self._on_net_state(msg.payload)
         elif kind == "transaction":
@@ -547,6 +583,16 @@ class Hydrabadger:
             peers_info = net_state[1]
             self._discover(peers_info)
         elif tag == "active" and self.dhb is None:
+            if (
+                self.key_gen is not None
+                and self.uid.bytes in tuple(bytes(n) for n in net_state[3])
+            ):
+                # we are IN the validator set and our own bootstrap DKG is
+                # still converging (a stalled link now healing via gossip):
+                # joining as an observer would discard our validator share
+                # — but keep dialling the peers the gossip just taught us
+                self._discover(net_state[7])
+                return
             (_tag, era, epoch, node_ids, pub_keys, pk_set_b, session, peers_info) = net_state
             plan = JoinPlan(
                 era=int(era),
@@ -566,11 +612,25 @@ class Hydrabadger:
             if uid == self.uid or self.peers.get_by_uid(uid) is not None:
                 continue
             remote = OutAddr(str(host), int(port))
-            if remote in self.peers.by_addr:
+            if remote in self.peers.by_addr or remote in self._dialing:
                 continue
             self._tasks.append(
                 asyncio.create_task(self._connect_outgoing(remote))
             )
+
+    def _park(self, peer: Peer, msg, body: bytes, sig: bytes) -> None:
+        """Hold a verified-kind frame that raced ahead of this
+        connection's handshake; _replay_parked re-runs it (in order,
+        signature still checked) once the peer's identity is known."""
+        if len(peer.parked) < 512:
+            peer.parked.append((msg, bytes(body), bytes(sig)))
+        else:
+            log.warning("parked-frame overflow from %s", peer.out_addr)
+
+    def _replay_parked(self, peer: Peer) -> None:
+        parked, peer.parked = peer.parked, []
+        for msg, body, sig in parked:
+            self._on_peer_msg(peer, msg, body, sig)
 
     def _resolve_duplicate(self, peer: Peer, uid: Uid) -> bool:
         """Keep one connection per node pair.  Both ends agree on the
@@ -712,7 +772,8 @@ class Hydrabadger:
                 engine=self.cfg.engine,
             )
             self.key_gen = None
-            self.keygen_outbox = []
+            # keep the outbox: stragglers behind a healing link still need
+            # the transcript (served on their net_state_request gossip)
             self.state = "validator"
             log.info("%s validator: era %d, %d nodes", self.uid,
                      self.cfg.start_epoch, len(node_ids))
@@ -824,6 +885,27 @@ class Hydrabadger:
         ):
             # vote the dead validator out (handler.rs:397-426)
             self.dhb.vote_to_remove(peer.uid.bytes)
+
+    async def _keygen_retry_loop(self) -> None:
+        """Bootstrap liveness: gossip + re-broadcast until DKG completes.
+
+        Two races can strand a booting network forever without retries:
+        (a) discovery only rides handshakes, so a node that dialled
+        before a mutual peer existed never learns about it — periodic
+        net_state_request gossip closes the gap (the reference re-gossips
+        NetworkState on its own retry ticks, handler.rs:319-395);
+        (b) duplicate connections being tie-broken can drop a Part/Ack
+        queued on the losing socket, stalling the n^2 ack gate — the
+        reference survives this with its wire retry queue
+        (handler.rs:660-670).  SyncKeyGen is duplicate-tolerant, so
+        periodic replay is safe and restores liveness."""
+        while self.dhb is None:
+            await asyncio.sleep(1.5)
+            if self.dhb is not None:
+                return  # consensus is live; dhb never goes back to None
+            self.peers.wire_to_all(WireMessage("net_state_request", None))
+            for msg in self.keygen_outbox:
+                self.peers.wire_to_all(msg)
 
     # -- workload generator (hydrabadger.rs:431-476) -------------------------
 

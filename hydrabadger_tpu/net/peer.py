@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 import logging
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..crypto.threshold import PublicKey
 from ..utils.ids import InAddr, OutAddr, Uid
@@ -33,6 +33,10 @@ class Peer:
     state: str = "handshaking"  # handshaking | established
     send_queue: asyncio.Queue = field(default_factory=asyncio.Queue)
     pump_task: Optional[asyncio.Task] = None
+    # frames that raced ahead of this connection's handshake; replayed
+    # (in order) once the peer establishes — the reference parks the
+    # same race in its wire retry queue (handler.rs:660-670)
+    parked: List[tuple] = field(default_factory=list)
 
     def establish(self, uid: Uid, in_addr: InAddr, pk: PublicKey) -> None:
         self.uid = uid

@@ -178,3 +178,87 @@ async def test_consensus_src_spoof_rejected():
         assert len(victim.iom_queue) == before
     finally:
         await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_disconnected_validator_voted_out():
+    """Fail-stop a validator: survivors vote it out (handler.rs:397-426),
+    the change commits, the era switches, and batches keep landing."""
+    base = BASE_PORT + 40
+    cfg = fast_config(keygen_peer_count=3)
+    nodes = await start_cluster(4, base, cfg)
+    try:
+        assert await wait_for(
+            lambda: all(n.is_validator() for n in nodes), timeout=30
+        )
+        assert await wait_for(
+            lambda: min(len(n.batches) for n in nodes) >= 1, timeout=30
+        )
+        victim = nodes[3]
+        victim_id = victim.our_id
+        await victim.stop()
+        survivors = nodes[:3]
+        assert await wait_for(
+            lambda: all(
+                n.dhb.era > 0 and victim_id not in n.dhb.netinfo.node_ids
+                for n in survivors
+            ),
+            timeout=45,
+        ), "victim never removed / era never switched"
+        counts = [len(n.batches) for n in survivors]
+        assert await wait_for(
+            lambda: all(
+                len(n.batches) > c for n, c in zip(survivors, counts)
+            ),
+            timeout=30,
+        ), "survivors stopped committing after removal"
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_restart_world_from_checkpoints_over_tcp():
+    """Stop every node, restore each from its NodeCheckpoint (epochs
+    aligned to the newest — the production restart recipe), reconnect
+    over TCP, and require fresh batches: SURVEY.md §5.4 end to end."""
+    import dataclasses
+
+    base = BASE_PORT + 50
+    nodes = await start_cluster(3, base)
+    try:
+        assert await wait_for(
+            lambda: min(len(n.batches) for n in nodes) >= 2, timeout=30
+        )
+    except BaseException:
+        await stop_cluster(nodes)
+        raise
+    await stop_cluster(nodes)
+    ckpts = [n.checkpoint() for n in nodes]
+    top = max(c.epoch for c in ckpts)
+    ckpts = [dataclasses.replace(c, epoch=top) for c in ckpts]
+
+    restored = []
+    for i, ck in enumerate(ckpts):
+        node = Hydrabadger.from_checkpoint(
+            InAddr("127.0.0.1", base + i), ck, fast_config(), seed=2000 + i
+        )
+        assert node.is_validator()
+        assert node.our_id == nodes[i].our_id
+        restored.append(node)
+    try:
+        for i, node in enumerate(restored):
+            remotes = [
+                OutAddr("127.0.0.1", base + j) for j in range(3) if j != i
+            ]
+            await node.start(remotes, gen_txns)
+        assert await wait_for(
+            lambda: min(len(n.batches) for n in restored) >= 2, timeout=30
+        ), "restored network never committed"
+        firsts = {
+            tuple(sorted(n.batches[0].contributions.items()))
+            for n in restored
+        }
+        assert len(firsts) == 1, "restored nodes disagree"
+        assert all(n.batches[0].epoch >= top for n in restored)
+    finally:
+        await stop_cluster(restored)

@@ -115,8 +115,8 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
     reference's threshold_crypto performs node-by-node inside
     hbbft::threshold_decrypt; measured on a sample and extrapolated
     (the loop is steady-state).  The TPU path runs every
-    (epoch x node) share as one lane of a single windowed (w=4)
-    double-and-add kernel.
+    (epoch x node) share as one lane of a single GLV dual-table
+    windowed kernel.
     """
     import random
 
@@ -144,14 +144,14 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
         bls.multiply(us[i % len(us)], sks[i % n_nodes])
     cpu_sps = sample / (time.perf_counter() - t0)
 
-    # TPU path: all epochs x nodes shares in one kernel
+    # TPU path: all epochs x nodes shares in one kernel (GLV ladder)
     points = bj.points_to_limbs([u for u in us for _ in range(n_nodes)])
-    wins = bj.scalars_to_windows(sks * epochs)
+    w1, w2 = bj.scalars_to_glv_windows(sks * epochs)
     dev_pts = jax.device_put(points)
-    dev_wins = jax.device_put(wins)
-    _sync(bj.jac_scalar_mul_windowed(dev_pts, dev_wins))  # compile + warm
+    dev_w1, dev_w2 = jax.device_put(w1), jax.device_put(w2)
+    _sync(bj.jac_scalar_mul_glv(dev_pts, dev_w1, dev_w2))  # compile + warm
     t0 = time.perf_counter()
-    _sync(bj.jac_scalar_mul_windowed(dev_pts, dev_wins))
+    _sync(bj.jac_scalar_mul_glv(dev_pts, dev_w1, dev_w2))
     dt = time.perf_counter() - t0
     accel_sps = epochs * n_nodes / dt
     return {

@@ -720,7 +720,15 @@ class Hydrabadger:
             if (machine is None or machine.kg is None) and self.dhb is None:
                 # peers ahead of us in the handshake dance; replayed when
                 # our own machine starts
-                self.keygen_inbox.append((src, instance_id, payload))
+                entry = (src, instance_id, payload)
+                # retry re-broadcasts repeat the transcript every tick:
+                # dedup + cap so a stalled bootstrap cannot grow the
+                # inbox without bound
+                if entry not in self.keygen_inbox:
+                    if len(self.keygen_inbox) < 4096:
+                        self.keygen_inbox.append(entry)
+                    else:
+                        log.warning("keygen inbox overflow; dropping frame")
                 return
         else:
             machine = self.user_key_gens.get(bytes(instance_id[1]))
@@ -860,6 +868,9 @@ class Hydrabadger:
             log.info("%s promoted to validator (era %d)", self.uid, self.dhb.era)
 
     def _on_batch(self, batch: DhbBatch) -> None:
+        if self.keygen_outbox and self.dhb.era != self.cfg.start_epoch:
+            # past the bootstrap era: no straggler can use the transcript
+            self.keygen_outbox = []
         self.batches.append(batch)
         self.current_epoch = batch.epoch + 1
         self.batch_queue.put_nowait(batch)
@@ -899,8 +910,11 @@ class Hydrabadger:
         reference survives this with its wire retry queue
         (handler.rs:660-670).  SyncKeyGen is duplicate-tolerant, so
         periodic replay is safe and restores liveness."""
+        delay = 1.5
         while self.dhb is None:
-            await asyncio.sleep(1.5)
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 12.0)  # back off: retries are a
+            # liveness net, not the primary delivery path
             if self.dhb is not None:
                 return  # consensus is live; dhb never goes back to None
             self.peers.wire_to_all(WireMessage("net_state_request", None))

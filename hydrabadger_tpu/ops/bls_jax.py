@@ -308,6 +308,96 @@ def scalars_to_windows(scalars: Sequence[int], n_bits: int = 256) -> np.ndarray:
     return bits.reshape(b, n // w, w) @ weights
 
 
+# -- GLV endomorphism (the production G1 ladder) ----------------------------
+#
+# BLS12-381's G1 has phi(x, y) = (beta*x, y) = lambda*(x, y) with
+# lambda = z^2 - 1 and — special to BLS curves — lambda^2 + lambda + 1
+# equals r EXACTLY, so any scalar splits as k = k1 + k2*lambda with both
+# halves <= 129 bits by plain divmod (no lattice reduction).  The ladder
+# then runs 33 windows (132 doubles) with TWO one-hot table adds per
+# window (the second table is the first with x scaled by beta — 16
+# fq_muls), ~1.2x the single-table w=4 ladder end to end.
+
+GLV_LAMBDA = (bls.X_PARAM * bls.X_PARAM - 1) % bls.R
+assert (GLV_LAMBDA * GLV_LAMBDA + GLV_LAMBDA + 1) % bls.R == 0
+_g = 2
+while pow(_g, (P - 1) // 3, P) == 1:
+    _g += 1
+_beta = pow(_g, (P - 1) // 3, P)
+# two non-trivial cube roots; pick the one matching GLV_LAMBDA
+_probe = bls.multiply(bls.G1, 12345)
+_target = bls.normalize(bls.multiply(_probe, GLV_LAMBDA))
+_aff = bls.normalize(_probe)
+if _target[0] != bls.FQ(_aff[0].n * _beta % P):
+    _beta = _beta * _beta % P
+assert bls.normalize(
+    (bls.FQ(_aff[0].n * _beta % P), _aff[1], bls.FQ(1))
+)[0] == _target[0]
+GLV_BETA = _beta
+BETA_MONT = int_to_limbs(GLV_BETA * R_MONT % P)
+GLV_WINDOWS = 33  # 132 bits cover the 129-bit k2 = k // lambda
+
+
+def scalars_to_glv_windows(scalars: Sequence[int]):
+    """k -> (k1 windows, k2 windows), each [B, 33] MSB-first 4-bit."""
+    k1s, k2s = [], []
+    for k in scalars:
+        k2, k1 = divmod(int(k) % bls.R, GLV_LAMBDA)
+        k1s.append(k1)
+        k2s.append(k2)
+    n_bits = GLV_WINDOWS * 4
+    w1 = scalars_to_bits(k1s, n_bits=n_bits)
+    w2 = scalars_to_bits(k2s, n_bits=n_bits)
+    wgt = (1 << np.arange(3, -1, -1)).astype(np.int32)
+    b = len(scalars)
+    return (
+        w1.reshape(b, GLV_WINDOWS, 4) @ wgt,
+        w2.reshape(b, GLV_WINDOWS, 4) @ wgt,
+    )
+
+
+@jax.jit
+def jac_scalar_mul_glv(
+    points: jax.Array, win1: jax.Array, win2: jax.Array
+) -> jax.Array:
+    """GLV dual-table ladder: [..., 3, 32] x two [..., 33] window sets."""
+    batch = points.shape[:-2]
+
+    def tbl_step(prev, _):
+        nxt = jac_add(prev, points)
+        return nxt, nxt
+
+    _, chain = jax.lax.scan(tbl_step, points, None, length=14)
+    t1 = jnp.concatenate(
+        [jac_infinity(batch)[None], points[None], chain], axis=0
+    )
+    t1 = jnp.moveaxis(t1, 0, -3)  # [..., 16, 3, 32]
+    bx = fq_mul(t1[..., 0, :], jnp.asarray(BETA_MONT))
+    t2 = jnp.concatenate([bx[..., None, :], t1[..., 1:, :]], axis=-2)
+
+    acc0 = jac_infinity(batch)
+
+    def step(acc, cols):
+        c1, c2 = cols
+        acc = jax.lax.fori_loop(0, 4, lambda _i, a: jac_double(a), acc)
+        oh1 = (c1[..., None] == jnp.arange(16, dtype=c1.dtype)).astype(
+            jnp.int32
+        )
+        oh2 = (c2[..., None] == jnp.arange(16, dtype=c2.dtype)).astype(
+            jnp.int32
+        )
+        acc = jac_add(acc, jnp.einsum("...t,...tcl->...cl", oh1, t1))
+        acc = jac_add(acc, jnp.einsum("...t,...tcl->...cl", oh2, t2))
+        return acc, None
+
+    acc, _ = jax.lax.scan(
+        step,
+        acc0,
+        (jnp.moveaxis(win1, -1, 0), jnp.moveaxis(win2, -1, 0)),
+    )
+    return acc
+
+
 @jax.jit
 def jac_scalar_mul_windowed(points: jax.Array, windows: jax.Array) -> jax.Array:
     """Fixed-window (w=4) scalar mul: ~2x fewer field muls than
@@ -474,8 +564,10 @@ def g1_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
     CPU points out.  This is decrypt-share generation for a whole batch
     of (instance, node) pairs at once."""
     pts = jnp.asarray(points_to_limbs(points))
-    wins = jnp.asarray(scalars_to_windows([s % bls.R for s in scalars]))
-    return limbs_to_points(jac_scalar_mul_windowed(pts, wins))
+    w1, w2 = scalars_to_glv_windows(scalars)
+    return limbs_to_points(
+        jac_scalar_mul_glv(pts, jnp.asarray(w1), jnp.asarray(w2))
+    )
 
 
 def g1_weighted_sum_batch(

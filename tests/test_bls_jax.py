@@ -153,3 +153,18 @@ def test_windowed_ladder_matches_bit_ladder_and_oracle():
         expected = bls.multiply(p, k)
         assert bls.eq(a, expected)
         assert bls.eq(b, expected)
+
+
+def test_glv_ladder_matches_oracle_edges():
+    """GLV decomposition + dual-table ladder vs the oracle, including
+    scalars straddling the lambda split."""
+    lam = bj.GLV_LAMBDA
+    ks = [0, 1, lam - 1, lam, lam + 1, bls.R - 1]
+    p = bls.multiply(bls.G1, 31337)
+    pts = jnp.asarray(bj.points_to_limbs([p] * len(ks)))
+    w1, w2 = bj.scalars_to_glv_windows(ks)
+    out = bj.limbs_to_points(
+        bj.jac_scalar_mul_glv(pts, jnp.asarray(w1), jnp.asarray(w2))
+    )
+    for k, got in zip(ks, out):
+        assert bls.eq(got, bls.multiply(p, k)), k

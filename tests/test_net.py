@@ -184,7 +184,7 @@ async def test_consensus_src_spoof_rejected():
 async def test_disconnected_validator_voted_out():
     """Fail-stop a validator: survivors vote it out (handler.rs:397-426),
     the change commits, the era switches, and batches keep landing."""
-    base = BASE_PORT + 40
+    base = BASE_PORT + 60
     cfg = fast_config(keygen_peer_count=3)
     nodes = await start_cluster(4, base, cfg)
     try:

@@ -35,6 +35,7 @@ resuming a consensus node from garbage.
 from __future__ import annotations
 
 import hashlib
+import hmac as hmac_mod
 import io
 import os
 import pickle
@@ -55,22 +56,65 @@ class CheckpointError(ValueError):
     pass
 
 
-def _pack(kind: int, payload: bytes) -> bytes:
-    digest = hashlib.sha256(payload).digest()
-    return _MAGIC + bytes([kind]) + digest + payload
+def _mac_key() -> Optional[bytes]:
+    """Optional authentication key from HYDRABADGER_CKPT_KEY.
+
+    The container's SHA-256 is integrity only; sim checkpoints restore
+    via pickle, so loading a file from outside the operator's trust
+    domain is arbitrary code execution.  When this env var is set, the
+    digest slot holds HMAC-SHA256(key, payload) instead, so checkpoints
+    that cross a machine boundary can be *authenticated*: a file written
+    without the key (or with a different one) refuses to load.
+    """
+    val = os.environ.get("HYDRABADGER_CKPT_KEY")
+    return val.encode() if val else None
 
 
-def _unpack(raw: bytes, kind: int) -> bytes:
-    if len(raw) < len(_MAGIC) + 1 + 32 or raw[: len(_MAGIC)] != _MAGIC:
+def _digest(payload: bytes, key: Optional[bytes]) -> bytes:
+    if key:
+        return hmac_mod.new(key, payload, hashlib.sha256).digest()
+    return hashlib.sha256(payload).digest()
+
+
+def _pack(kind: int, payload: bytes, key: Optional[bytes] = None) -> bytes:
+    if key is None:
+        key = _mac_key()
+    # container: MAGIC | kind | auth-flag | digest | payload — the flag
+    # records whether the digest slot is plain SHA-256 (0) or
+    # HMAC-SHA256 (1), so a key mismatch reports itself instead of
+    # masquerading as file corruption.
+    return _MAGIC + bytes([kind, 1 if key else 0]) + _digest(payload, key) + payload
+
+
+def _unpack(raw: bytes, kind: int, key: Optional[bytes] = None) -> bytes:
+    if key is None:
+        key = _mac_key()
+    m = len(_MAGIC)
+    if len(raw) < m + 2 + 32 or raw[:m] != _MAGIC:
         raise CheckpointError("not a hydrabadger_tpu checkpoint")
-    if raw[len(_MAGIC)] != kind:
+    if raw[m] != kind:
         raise CheckpointError(
-            f"checkpoint kind mismatch: got {raw[len(_MAGIC)]}, want {kind}"
+            f"checkpoint kind mismatch: got {raw[m]}, want {kind}"
         )
-    digest = raw[len(_MAGIC) + 1 : len(_MAGIC) + 33]
-    payload = raw[len(_MAGIC) + 33 :]
-    if hashlib.sha256(payload).digest() != digest:
-        raise CheckpointError("checkpoint integrity check failed")
+    authed = raw[m + 1]
+    if authed not in (0, 1):
+        raise CheckpointError("unknown checkpoint auth flag")
+    digest = raw[m + 2 : m + 34]
+    payload = raw[m + 34 :]
+    if authed and not key:
+        raise CheckpointError(
+            "checkpoint is authenticated; set HYDRABADGER_CKPT_KEY to load it"
+        )
+    if key and not authed:
+        raise CheckpointError(
+            "HYDRABADGER_CKPT_KEY is set but this checkpoint is "
+            "unauthenticated (plain sha256); unset the key to accept it"
+        )
+    if not hmac_mod.compare_digest(_digest(payload, key if authed else None), digest):
+        raise CheckpointError(
+            "checkpoint integrity check failed"
+            + (" (authenticated checkpoint: wrong key?)" if authed else "")
+        )
     return payload
 
 

@@ -82,12 +82,17 @@ class BinaryAgreement:
 
     @guarded_handler("ba")
     def handle_message(self, sender, message) -> Step:
-        if self.terminated:
-            return Step()
         _tag, rnd, content = message[0], int(message[1]), message[2]
         kind = content[0]
         if kind == "term":
+            # Term is processed even after termination: a node whose
+            # round bound exhausted (terminated, decision None) must
+            # still be rescuable by f+1 matching Terms, or honest nodes
+            # could diverge (one decides in round MAX_ROUNDS-1, another
+            # exhausts).  _handle_term is idempotent once decided.
             return self._handle_term(sender, bool(content[1]))
+        if self.terminated:
+            return Step()
         if rnd >= MAX_ROUNDS:
             return Step().fault(sender, "ba: round out of range")
         if rnd < self.round:
@@ -261,7 +266,16 @@ class BinaryAgreement:
             self.estimate = coin
         self.round = rnd + 1
         if self.round >= MAX_ROUNDS:
-            raise RuntimeError("binary agreement exceeded round bound")
+            # Terminal fault entry, never an exception: a coin-splitting
+            # adversary must not be able to crash the node.  `decision`
+            # stays None, which Subset records as a not-accepted slot —
+            # liveness for this instance is already gone if an adversary
+            # kept the coin split for MAX_ROUNDS rounds.
+            self.terminated = True
+            return step.fault(
+                self.netinfo.our_id,
+                "ba: round bound exhausted without agreement",
+            )
         step.extend(self._send_bval(self.round, self.estimate))
         step.extend(self._replay_round(self.round))
         return step
@@ -286,7 +300,7 @@ class BinaryAgreement:
     # -- termination --------------------------------------------------------
 
     def _decide(self, b: bool) -> Step:
-        if self.terminated:
+        if self.decision is not None:
             return Step()
         self.decision = b
         self.terminated = True
@@ -302,6 +316,6 @@ class BinaryAgreement:
             return Step()
         self.received_term[b].add(sender)
         f = self.netinfo.num_faulty
-        if len(self.received_term[b]) >= f + 1 and not self.terminated:
+        if len(self.received_term[b]) >= f + 1 and self.decision is None:
             return self._decide(b)
         return Step()

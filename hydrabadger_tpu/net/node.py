@@ -41,6 +41,16 @@ from .wire import WireMessage, WireStream
 
 log = logging.getLogger("hydrabadger_tpu.net")
 
+# Pre-handshake frame parking budgets (per connection): frames that race
+# ahead of the handshake are held and replayed on establish, but an
+# unauthenticated peer gets a small, fixed budget — count AND bytes.
+PARKED_FRAME_CAP = 512
+PARKED_BYTES_CAP = 4 * 1024 * 1024
+# Keygen frames arriving before our own machine starts are queued for
+# replay; retry storms re-send the transcript every tick, so membership
+# checks must be O(1) (a set mirrors the ordered list).
+KEYGEN_INBOX_CAP = 4096
+
 
 @dataclass
 class Config:
@@ -164,6 +174,7 @@ class Hydrabadger:
         self.keygen_outbox: List[WireMessage] = []
         # keygen traffic that arrived before our own machine started
         self.keygen_inbox: List[tuple] = []
+        self._keygen_inbox_seen: set = set()  # O(1) dedup mirror
         self.iom_queue: List[tuple] = []  # messages before DHB exists
         self.batch_queue: asyncio.Queue = asyncio.Queue()
         self.batches: List[DhbBatch] = []
@@ -622,13 +633,21 @@ class Hydrabadger:
         """Hold a verified-kind frame that raced ahead of this
         connection's handshake; _replay_parked re-runs it (in order,
         signature still checked) once the peer's identity is known."""
-        if len(peer.parked) < 512:
+        # budget by count AND bytes: an unauthenticated connection must
+        # not be able to pin memory by streaming huge pre-handshake
+        # frames (frame cap is 64 MB; 512 of those would be 32 GB)
+        if (
+            len(peer.parked) < PARKED_FRAME_CAP
+            and peer.parked_bytes + len(body) <= PARKED_BYTES_CAP
+        ):
             peer.parked.append((msg, bytes(body), bytes(sig)))
+            peer.parked_bytes += len(body)
         else:
             log.warning("parked-frame overflow from %s", peer.out_addr)
 
     def _replay_parked(self, peer: Peer) -> None:
         parked, peer.parked = peer.parked, []
+        peer.parked_bytes = 0
         for msg, body, sig in parked:
             self._on_peer_msg(peer, msg, body, sig)
 
@@ -705,6 +724,7 @@ class Hydrabadger:
             self.key_gen.handle_ack(self.uid.bytes, outcome.ack)
         # replay keygen traffic that beat us here
         pending, self.keygen_inbox = self.keygen_inbox, []
+        self._keygen_inbox_seen = set()
         for src, instance_id, payload in pending:
             self._on_key_gen_message(src, instance_id, payload)
         self._maybe_finish_keygen(self.key_gen)
@@ -722,11 +742,12 @@ class Hydrabadger:
                 # our own machine starts
                 entry = (src, instance_id, payload)
                 # retry re-broadcasts repeat the transcript every tick:
-                # dedup + cap so a stalled bootstrap cannot grow the
-                # inbox without bound
-                if entry not in self.keygen_inbox:
-                    if len(self.keygen_inbox) < 4096:
+                # dedup (O(1) via the set mirror) + cap so a stalled
+                # bootstrap cannot grow the inbox without bound
+                if entry not in self._keygen_inbox_seen:
+                    if len(self.keygen_inbox) < KEYGEN_INBOX_CAP:
                         self.keygen_inbox.append(entry)
+                        self._keygen_inbox_seen.add(entry)
                     else:
                         log.warning("keygen inbox overflow; dropping frame")
                 return

@@ -37,6 +37,7 @@ class Peer:
     # (in order) once the peer establishes — the reference parks the
     # same race in its wire retry queue (handler.rs:660-670)
     parked: List[tuple] = field(default_factory=list)
+    parked_bytes: int = 0  # cumulative body bytes parked (budgeted)
 
     def establish(self, uid: Uid, in_addr: InAddr, pk: PublicKey) -> None:
         self.uid = uid

@@ -75,7 +75,10 @@ def main(argv=None) -> int:
     p.add_argument(
         "--resume", default=None, metavar="PATH",
         help="resume from a sim checkpoint instead of starting fresh "
-        "(--epochs counts additional epochs; topology flags are ignored)",
+        "(--epochs counts additional epochs; topology flags are ignored). "
+        "WARNING: sim checkpoints restore via pickle — only resume files "
+        "from your own trust domain, or set HYDRABADGER_CKPT_KEY on both "
+        "ends to require an authenticated (HMAC) checkpoint",
     )
     args = p.parse_args(argv)
     if args.nodes < 1:

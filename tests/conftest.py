@@ -29,6 +29,24 @@ except Exception:  # pragma: no cover - jax-less environments still test
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Build the native host libraries on demand (they are build artifacts,
+# never committed; crypto/_native.py falls back to numpy/pure-Python
+# when a build is impossible, so failure here is non-fatal).
+import subprocess
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+try:
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "-s"],
+        check=False,
+        timeout=180,
+        capture_output=True,
+    )
+except Exception:  # pragma: no cover - toolchain-less environments
+    pass
+
 # Minimal async test support (pytest-asyncio is not in the image):
 # any `async def` test runs under asyncio.run().
 import asyncio

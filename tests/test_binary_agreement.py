@@ -100,3 +100,44 @@ def test_term_shortcut_rescues_late_node():
         n, [True, True, True, False], adversary=adversary
     )
     assert router.outputs[victim] and router.outputs[victim][0] == router.outputs["n0"][0]
+
+
+def test_split_coin_round_bound_is_terminal_fault():
+    """An adversary that keeps both values alive in every round drives
+    the instance to MAX_ROUNDS: it must terminate with a fault entry,
+    never let an exception escape handle_message (VERDICT r1 weak #3)."""
+    from hydrabadger_tpu.consensus.binary_agreement import MAX_ROUNDS
+
+    ids = [f"n{i}" for i in range(4)]
+    ni = NetworkInfo("n0", ids, pk_set=None)
+    aba = BinaryAgreement(ni, b"sid", coin_mode="hash")
+    aba.propose(True)
+    faults = []
+    for _ in range(MAX_ROUNDS + 2):
+        if aba.terminated:
+            break
+        rnd = aba.round
+        for b in (True, False):
+            for s in ("n1", "n2", "n3"):
+                faults += aba.handle_message(s, ("ba", rnd, ("bval", b))).fault_log
+        faults += aba.handle_message("n1", ("ba", rnd, ("aux", True))).fault_log
+        faults += aba.handle_message("n2", ("ba", rnd, ("aux", False))).fault_log
+        faults += aba.handle_message(
+            "n1", ("ba", rnd, ("conf", (False, True)))
+        ).fault_log
+        faults += aba.handle_message(
+            "n2", ("ba", rnd, ("conf", (False, True)))
+        ).fault_log
+    assert aba.terminated
+    assert aba.decision is None
+    assert any("round bound" in f.kind for f in faults)
+    # post-termination protocol traffic is inert
+    quiet = aba.handle_message("n1", ("ba", 0, ("bval", True)))
+    assert not quiet.messages and not quiet.output
+    # ...but the f+1-Term rescue still lands: an exhausted node must be
+    # able to adopt a decision reached by peers in an earlier round, or
+    # honest nodes could diverge
+    aba.handle_message("n1", ("ba", 5, ("term", True)))
+    step = aba.handle_message("n2", ("ba", 5, ("term", True)))
+    assert aba.decision is True
+    assert step.output == [True]

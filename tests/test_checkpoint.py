@@ -156,3 +156,30 @@ class TestCli:
 
         lines = capsys.readouterr().out.strip().splitlines()
         assert _json.loads(lines[-1])["epochs_done"] == 4
+
+
+def test_authenticated_checkpoint_hmac(tmp_path, monkeypatch):
+    """HYDRABADGER_CKPT_KEY turns the container digest into an HMAC:
+    key mismatches and key/no-key crossings fail loudly and honestly."""
+    import pytest
+
+    from hydrabadger_tpu import checkpoint as ckpt
+
+    payload = b"payload-bytes"
+    monkeypatch.setenv("HYDRABADGER_CKPT_KEY", "sekrit")
+    boxed = ckpt._pack(ckpt._KIND_SIM, payload)
+    assert ckpt._unpack(boxed, ckpt._KIND_SIM) == payload
+    # wrong key -> integrity failure that names authentication
+    monkeypatch.setenv("HYDRABADGER_CKPT_KEY", "other")
+    with pytest.raises(ckpt.CheckpointError, match="wrong key"):
+        ckpt._unpack(boxed, ckpt._KIND_SIM)
+    # no key -> told to set the key, not "corrupt file"
+    monkeypatch.delenv("HYDRABADGER_CKPT_KEY")
+    with pytest.raises(ckpt.CheckpointError, match="set HYDRABADGER_CKPT_KEY"):
+        ckpt._unpack(boxed, ckpt._KIND_SIM)
+    # plain file + key set -> explicit refusal
+    plain = ckpt._pack(ckpt._KIND_SIM, payload)
+    assert ckpt._unpack(plain, ckpt._KIND_SIM) == payload
+    monkeypatch.setenv("HYDRABADGER_CKPT_KEY", "sekrit")
+    with pytest.raises(ckpt.CheckpointError, match="unauthenticated"):
+        ckpt._unpack(plain, ckpt._KIND_SIM)

@@ -348,6 +348,10 @@ def double(pt):
 
 
 def add(p1, p2):
+    return _add_impl(p1, p2)
+
+
+def _add_impl(p1, p2):
     if is_inf(p1):
         return p2
     if is_inf(p2):
@@ -378,9 +382,13 @@ def neg(pt):
 
 
 def multiply(pt, n: int):
-    """Scalar multiplication (double-and-add, MSB first)."""
+    """Scalar multiplication (double-and-add, MSB first).
+
+    Self-contained pure Python (no dispatch re-entry): this body survives
+    as the `_py_multiply` oracle after the native dispatch section
+    rebinds the public name."""
     if n < 0:
-        return multiply(neg(pt), -n)
+        pt, n = neg(pt), -n
     if n == 0 or is_inf(pt):
         return infinity(type(pt[0]) if not isinstance(pt[0], FQ) else FQ)
     result = None
@@ -388,7 +396,7 @@ def multiply(pt, n: int):
         if result is not None:
             result = double(result)
         if bit == "1":
-            result = pt if result is None else add(result, pt)
+            result = pt if result is None else _add_impl(result, pt)
     return result
 
 
@@ -397,6 +405,13 @@ def normalize(pt):
     if is_inf(pt):
         return None
     x, y, z = pt
+    # points returned by the native engine are already affine (z == 1);
+    # skip the Fermat inversion (a 381-bit pow) in that common case
+    if isinstance(z, FQ):
+        if z.n == 1:
+            return (x, y)
+    elif z.coeffs[0] == 1 and all(c == 0 for c in z.coeffs[1:]):
+        return (x, y)
     zinv = z.inv()
     return (x * zinv, y * zinv)
 
@@ -585,10 +600,67 @@ def hash_to_g2(msg: bytes, domain: bytes = b"HBTPU-G2") -> tuple:
         if y is not None:
             if raw[96] & 1:
                 y = -y
-            pt = multiply((x, y, FQ2.one()), H2_COFACTOR)
+            pt = clear_cofactor_g2((x, y, FQ2.one()))
             if not is_inf(pt):
                 return pt
         ctr += 1
+
+
+# -- endomorphisms (fast subgroup checks + cofactor clearing) ---------------
+# psi = untwist-Frobenius-twist on E'(Fp2): eigenvalue x on G2 (p == x mod
+# r); phi(x, y) = (beta x, y) on E(Fp): eigenvalue -x^2 on G1.  The
+# eigenvalue membership tests are exactly sufficient: a passing point's
+# order divides gcd(h2 r, p - x) = r (resp. x^4 - x^2 + 1 = r itself).
+# Cofactor clearing is Budroni-Pintore eta = (x^2-x-1) + (x-1) psi + 2 psi^2,
+# which maps all of E'(Fp2) into G2 — the native engine
+# (native/bls12_381.cpp) implements the identical maps.
+
+_PSI_CX = (FQ2([1, 1]) ** ((P - 1) // 3)).inv()
+_PSI_CY = (FQ2([1, 1]) ** ((P - 1) // 2)).inv()
+_SQRT_M3 = pow(P - 3, (P + 1) // 4, P)
+BETA = (-1 + _SQRT_M3) * pow(2, -1, P) % P  # cube root of unity for phi
+assert pow(BETA, 3, P) == 1 and BETA != 1
+
+
+def psi(pt):
+    """The p-power endomorphism on E'(Fp2) (projective-safe)."""
+    x, y, z = pt
+    return (x.conjugate() * _PSI_CX, y.conjugate() * _PSI_CY, z.conjugate())
+
+
+def in_g1_subgroup(pt) -> bool:
+    """phi(P) == [-x^2]P; order of any passing point divides r."""
+    if is_inf(pt):
+        return True
+    from . import native_bls as _nbl
+
+    if _nbl.available():
+        return _nbl.g1_in_subgroup(pt)
+    x, y, z = pt
+    return eq((FQ(BETA) * x, y, z), neg(_py_multiply(pt, X_PARAM * X_PARAM)))
+
+
+def in_g2_subgroup(pt) -> bool:
+    """psi(P) == [x]P; order of any passing point divides r."""
+    if is_inf(pt):
+        return True
+    from . import native_bls as _nbl
+
+    if _nbl.available():
+        return _nbl.g2_in_subgroup(pt)
+    return eq(psi(pt), neg(_py_multiply(pt, -X_PARAM)))
+
+
+def clear_cofactor_g2(pt):
+    """[x^2-x-1]P + [x-1]psi(P) + psi^2(2P) — lands in G2 for all of E'.
+
+    Pure-Python internals: the input may carry cofactor components, which
+    the GLS-accelerated dispatcher must never see (the native hash path
+    does its own clearing in C++)."""
+    t1 = _py_multiply(pt, X_PARAM * X_PARAM - X_PARAM - 1)
+    t2 = _py_multiply(psi(pt), X_PARAM - 1)
+    t3 = psi(psi(_py_add(pt, pt)))
+    return _py_add(_py_add(t1, t2), t3)
 
 
 def _fq_sign(n: int) -> int:
@@ -624,7 +696,7 @@ def g1_from_bytes(raw: bytes):
     pt = (x, y, FQ(1))
     if not is_on_curve(pt, B1):
         raise ValueError("point not on curve")
-    if not is_inf(multiply(pt, R)):
+    if not in_g1_subgroup(pt):
         # on the curve but outside the r-order subgroup: a cofactor
         # component would defeat batch verification's soundness (an
         # attacker-added small-order term vanishes whenever the random
@@ -671,9 +743,113 @@ def g2_from_bytes(raw: bytes):
     pt = (x, y, FQ2.one())
     if not is_on_curve(pt, B2):
         raise ValueError("point not on curve")
-    if not is_inf(multiply(pt, R)):
+    if not in_g2_subgroup(pt):
         # E'(Fp2) has cofactor h2 with small prime factors (13^2, 23^2,
         # ...): without this check a mauled signature sig+T (ord(T)=13)
         # passes batch verification with probability ~1/13
         raise ValueError("G2 point not in the r-order subgroup")
+    return pt
+
+
+# ---------------------------------------------------------------------------
+# Native dispatch
+# ---------------------------------------------------------------------------
+# The native host engine (native/bls12_381.cpp, SURVEY.md §2.2: the
+# reference's crypto is native Rust, so the parity path here must be C++,
+# not a Python stand-in) takes over the public group/pairing operations
+# when its shared library is present.  The pure-Python definitions above
+# remain the bit-exact oracle: tests run both paths and compare.
+
+_py_multiply = multiply
+_py_add = add
+_py_pairing_check_eq = pairing_check_eq
+_py_pairing_product_check = pairing_product_check
+_py_hash_to_g2 = hash_to_g2
+
+from . import native_bls as _nb  # noqa: E402  (needs FQ/FQ2 defined)
+
+
+def multiply(pt, n: int):  # noqa: F811
+    """Scalar multiplication; native C++ for G1/G2, Python for E(Fp12).
+
+    Correct for ANY curve point (generic double-and-add ladders); use
+    mul_sub() for r-order subgroup points to get the endomorphism-
+    accelerated (GLV/GLS) ladders."""
+    if _nb.available():
+        t = type(pt[0])
+        if t is FQ:
+            return _nb.g1_mul(pt, n)
+        if t is FQ2:
+            return _nb.g2_mul(pt, n)
+    return _py_multiply(pt, n)
+
+
+def mul_sub(pt, n: int):
+    """Scalar multiplication for points KNOWN to lie in the r-order
+    subgroup (every protocol point: generator multiples, decode-checked
+    wire points, cleared hash outputs).  Uses the 2-dim GLV (G1) / 4-dim
+    GLS (G2) endomorphism ladders — ~2x / ~4x the generic ladder.  Not
+    valid for cofactor-bearing points (clear_cofactor_g2 internals and
+    the subgroup checks themselves use generic/pure paths)."""
+    if _nb.available():
+        t = type(pt[0])
+        if t is FQ:
+            return _nb.g1_mul_sub(pt, n)
+        if t is FQ2:
+            return _nb.g2_mul_sub(pt, n)
+    return _py_multiply(pt, n)
+
+
+def add(p1, p2):  # noqa: F811
+    if _nb.available():
+        t = type(p1[2])
+        if t is FQ:
+            return _nb.g1_add(p1, p2)
+        if t is FQ2:
+            return _nb.g2_add(p1, p2)
+    return _py_add(p1, p2)
+
+
+def pairing_check_eq(p1, q1, p2, q2) -> bool:  # noqa: F811
+    if _nb.available():
+        return _nb.pairing_check_eq(p1, q1, p2, q2)
+    return _py_pairing_check_eq(p1, q1, p2, q2)
+
+
+def pairing_product_check(pairs) -> bool:  # noqa: F811
+    pairs = list(pairs)
+    if _nb.available():
+        return _nb.pairing_product_check(pairs)
+    return _py_pairing_product_check(pairs)
+
+
+# Digest-keyed LRU for hash_to_g2: one message is hashed by the signer
+# and every verifier of a frame (a coin round hashes one message per
+# node).  Keys are 32-byte digests — never the message bodies, which can
+# be multi-MB wire frames — so memory stays bounded at ~4096 points.
+from collections import OrderedDict  # noqa: E402
+
+_H_CACHE: "OrderedDict[bytes, tuple]" = OrderedDict()
+_H_CACHE_MAX = 4096
+
+
+def _hash_cache_clear() -> None:
+    _H_CACHE.clear()
+
+
+def hash_to_g2(msg: bytes, domain: bytes = b"HBTPU-G2") -> tuple:  # noqa: F811
+    key = hashlib.sha256(
+        len(domain).to_bytes(4, "big") + domain + msg
+    ).digest()
+    pt = _H_CACHE.get(key)
+    if pt is not None:
+        _H_CACHE.move_to_end(key)
+        return pt
+    if _nb.available():
+        pt = _nb.hash_to_g2(msg, domain)
+    else:
+        pt = _py_hash_to_g2(msg, domain)
+    _H_CACHE[key] = pt
+    if len(_H_CACHE) > _H_CACHE_MAX:
+        _H_CACHE.popitem(last=False)
     return pt

@@ -30,7 +30,7 @@ from typing import Dict, Generic, Hashable, List, Mapping, Optional, Tuple, Type
 
 from ..utils import codec
 from . import bls12_381 as bls
-from .bls12_381 import FQ, G1, R, add, eq, g1_from_bytes, g1_to_bytes, infinity, multiply
+from .bls12_381 import FQ, G1, R, add, eq, g1_from_bytes, g1_to_bytes, infinity, mul_sub, multiply
 from .threshold import (
     Ciphertext,
     PublicKey,
@@ -85,7 +85,7 @@ class BivarPoly:
 
     def commitment(self) -> "BivarCommitment":
         return BivarCommitment(
-            [[multiply(G1, c) for c in row] for row in self.coeffs]
+            [[mul_sub(G1, c) for c in row] for row in self.coeffs]
         )
 
 
@@ -102,7 +102,7 @@ class BivarCommitment:
         for j in range(self.t + 1):
             yk = 1
             for k in range(self.t + 1):
-                acc = add(acc, multiply(self.points[j][k], xj * yk % R))
+                acc = add(acc, mul_sub(self.points[j][k], xj * yk % R))
                 yk = yk * y % R
             xj = xj * x % R
         return acc
@@ -114,7 +114,7 @@ class BivarCommitment:
         for k in range(self.t + 1):
             acc = infinity(FQ)
             for j in range(self.t + 1):
-                acc = add(acc, multiply(self.points[j][k], xs[j]))
+                acc = add(acc, mul_sub(self.points[j][k], xs[j]))
             out.append(acc)
         return out
 
@@ -254,7 +254,7 @@ class SyncKeyGen(Generic[N]):
         # verify our row against the commitment
         expected = commit.row_commitment(self.our_idx + 1)
         for k, coeff in enumerate(row):
-            if not eq(multiply(G1, coeff), expected[k]):
+            if not eq(mul_sub(G1, coeff), expected[k]):
                 return PartOutcome(False, fault="row/commitment mismatch")
         state = _ProposalState(commit, row=row)
         self.parts[s] = state
@@ -286,7 +286,7 @@ class SyncKeyGen(Generic[N]):
             return AckOutcome(False, fault="undecryptable value")
         # verify val == f_s(m+1, our_idx+1) against commitment
         expected = state.commitment.evaluate(m + 1, self.our_idx + 1)
-        if not eq(multiply(G1, val), expected):
+        if not eq(mul_sub(G1, val), expected):
             return AckOutcome(False, fault="value/commitment mismatch")
         state.acks.add(m)
         state.values[m + 1] = val

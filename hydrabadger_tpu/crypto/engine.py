@@ -137,7 +137,7 @@ class CpuEngine:
         ]
         agg_sig = bls.infinity(bls.FQ2)
         for (pk, sig, msg), r in zip(items, rs):
-            agg_sig = bls.add(agg_sig, bls.multiply(sig.point, r))
+            agg_sig = bls.add(agg_sig, bls.mul_sub(sig.point, r))
         weighted_pks = self._g1_scalar_muls(
             [pk.point for pk, _sig, _msg in items], rs
         )
@@ -152,7 +152,10 @@ class CpuEngine:
     def _g1_scalar_muls(self, points: Sequence, scalars: Sequence[int]) -> List:
         """Hook: batch G1 scalar muls (TPU engine overrides)."""
         from . import bls12_381 as bls
+        from . import native_bls as nb
 
+        if nb.available():
+            return nb.g1_mul_batch(points, scalars)
         return [bls.multiply(p, r) for p, r in zip(points, scalars)]
 
     # -- threshold encryption (hbbft::threshold_decrypt) --------------------
@@ -232,14 +235,15 @@ class CpuEngine:
         hash per distinct msg; sign_share re-hashes internally so we
         multiply directly); the TPU engine runs every share as one lane
         of the G2 ladder."""
-        from .bls12_381 import multiply
+        from .bls12_381 import mul_sub
 
         h_cache: Dict[bytes, tuple] = {}
         for _sk, msg in items:
             if msg not in h_cache:  # setdefault would hash eagerly
                 h_cache[msg] = th.hash_to_g2(msg)
+        # hash outputs are in the r-order subgroup: GLS ladder applies
         return [
-            th.SignatureShare(multiply(h_cache[msg], sk.scalar))
+            th.SignatureShare(mul_sub(h_cache[msg], sk.scalar))
             for sk, msg in items
         ]
 

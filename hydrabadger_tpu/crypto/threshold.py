@@ -39,6 +39,7 @@ from .bls12_381 import (
     hash_to_g2,
     infinity,
     is_inf,
+    mul_sub,
     multiply,
     neg,
     normalize,
@@ -100,10 +101,18 @@ def lagrange_coeffs_at_zero(xs: Sequence[int]) -> list[int]:
 
 def interpolate_g_at_zero(points: Mapping[int, tuple]) -> tuple:
     """Lagrange interpolation *in the exponent*: Σ λ_i · P_i, at x=0."""
+    from . import native_bls as _nb
+
     xs = list(points.keys())
     lam = lagrange_coeffs_at_zero(xs)
     first = points[xs[0]]
     field = FQ if isinstance(first[0], FQ) else type(first[0])
+    if _nb.available():
+        pts = [points[xi] for xi in xs]
+        if field is FQ:
+            return _nb.g1_weighted_sum(pts, lam)
+        if field is FQ2:
+            return _nb.g2_weighted_sum(pts, lam)
     acc = infinity(field)
     for xi, li in zip(xs, lam):
         acc = add(acc, multiply(points[xi], li))
@@ -174,11 +183,11 @@ class PublicKey:
 
     def encrypt(self, msg: bytes, rng) -> "Ciphertext":
         r = fr_random(rng)
-        u = multiply(G1, r)
+        u = mul_sub(G1, r)
         v = bytes(
-            a ^ b for a, b in zip(msg, _kdf(multiply(self.point, r), len(msg)))
+            a ^ b for a, b in zip(msg, _kdf(mul_sub(self.point, r), len(msg)))
         )
-        w = multiply(hash_to_g2(g1_to_bytes(u) + v, b"HBTPU-TE"), r)
+        w = mul_sub(hash_to_g2(g1_to_bytes(u) + v, b"HBTPU-TE"), r)
         return Ciphertext(u, v, w)
 
     def __eq__(self, other):
@@ -215,10 +224,10 @@ class SecretKey:
         return self.scalar.to_bytes(32, "big")
 
     def public_key(self) -> PublicKey:
-        return PublicKey(multiply(G1, self.scalar))
+        return PublicKey(mul_sub(G1, self.scalar))
 
     def sign(self, msg: bytes) -> Signature:
-        return Signature(multiply(hash_to_g2(msg), self.scalar))
+        return Signature(mul_sub(hash_to_g2(msg), self.scalar))
 
     def decrypt(self, ct: "Ciphertext", verify: bool = True) -> Optional[bytes]:
         """Non-threshold decryption by the full key owner.
@@ -230,19 +239,19 @@ class SecretKey:
             return None
         return bytes(
             a ^ b
-            for a, b in zip(ct.v, _kdf(multiply(ct.u, self.scalar), len(ct.v)))
+            for a, b in zip(ct.v, _kdf(mul_sub(ct.u, self.scalar), len(ct.v)))
         )
 
 
 class SecretKeyShare(SecretKey):
     def sign_share(self, msg: bytes) -> SignatureShare:
-        return SignatureShare(multiply(hash_to_g2(msg), self.scalar))
+        return SignatureShare(mul_sub(hash_to_g2(msg), self.scalar))
 
     def decrypt_share(self, ct: "Ciphertext") -> "DecryptionShare":
-        return DecryptionShare(multiply(ct.u, self.scalar))
+        return DecryptionShare(mul_sub(ct.u, self.scalar))
 
     def public_key_share(self) -> PublicKeyShare:
-        return PublicKeyShare(multiply(G1, self.scalar))
+        return PublicKeyShare(mul_sub(G1, self.scalar))
 
 
 # ---------------------------------------------------------------------------
@@ -322,7 +331,7 @@ class SecretKeySet:
         return SecretKeyShare(poly_eval(self.coeffs, i + 1))
 
     def public_keys(self) -> "PublicKeySet":
-        return PublicKeySet([multiply(G1, c) for c in self.coeffs])
+        return PublicKeySet([mul_sub(G1, c) for c in self.coeffs])
 
 
 class PublicKeySet:
@@ -343,7 +352,7 @@ class PublicKeySet:
         acc = infinity(FQ)
         xk = 1
         for c in self.commitment:
-            acc = add(acc, multiply(c, xk))
+            acc = add(acc, mul_sub(c, xk))
             xk = xk * x % R
         return PublicKeyShare(acc)
 

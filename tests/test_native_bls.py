@@ -1,0 +1,173 @@
+"""Cross-engine bit-equality: native C++ BLS12-381 vs the pure-Python oracle.
+
+SURVEY.md §2.2 demands the host crypto hot path be native (the reference's
+is Rust: pairing/threshold_crypto, lib.rs:406-447); VERDICT r1 item 1 made
+this the round-2 headline.  These tests pin the two engines together:
+every public group/pairing operation must agree bit-for-bit, including the
+edge cases (infinity, zero/negative/oversize scalars, cofactor-range
+scalars on non-subgroup points).
+"""
+import random
+
+import pytest
+
+from hydrabadger_tpu.crypto import bls12_381 as bls
+from hydrabadger_tpu.crypto import native_bls as nb
+from hydrabadger_tpu.crypto import threshold as th
+
+pytestmark = pytest.mark.skipif(
+    not nb.available(), reason="native BLS library not built"
+)
+
+
+@pytest.fixture
+def rng():
+    return random.Random(0xB15)
+
+
+def test_native_selftest():
+    """The library's built-in sparse-vs-reference Miller loop cross-check."""
+    assert nb._load().bls_selftest() == 1
+
+
+def test_g1_mul_matches_python(rng):
+    for k in [0, 1, 2, bls.R - 1, bls.R, bls.R + 5, rng.getrandbits(255), -7]:
+        assert bls.eq(nb.g1_mul(bls.G1, k), bls._py_multiply(bls.G1, k))
+
+
+def test_g2_mul_matches_python(rng):
+    for k in [0, 1, 2, bls.R - 1, bls.R, rng.getrandbits(255), -3]:
+        assert bls.eq(nb.g2_mul(bls.G2, k), bls._py_multiply(bls.G2, k))
+
+
+def test_add_matches_python(rng):
+    p = bls._py_multiply(bls.G1, rng.getrandbits(64))
+    q = bls._py_multiply(bls.G1, rng.getrandbits(64))
+    assert bls.eq(nb.g1_add(p, q), bls._py_add(p, q))
+    assert bls.eq(nb.g1_add(p, p), bls._py_add(p, p))  # doubling branch
+    assert bls.is_inf(nb.g1_add(p, bls.neg(p)))
+    assert bls.eq(nb.g1_add(p, bls.infinity(bls.FQ)), p)
+    p2 = bls._py_multiply(bls.G2, rng.getrandbits(64))
+    q2 = bls._py_multiply(bls.G2, rng.getrandbits(64))
+    assert bls.eq(nb.g2_add(p2, q2), bls._py_add(p2, q2))
+
+
+def test_mul_batch(rng):
+    pts = [bls._py_multiply(bls.G1, rng.getrandbits(32)) for _ in range(5)]
+    ks = [rng.getrandbits(255) for _ in range(5)]
+    for got, p, k in zip(nb.g1_mul_batch(pts, ks), pts, ks):
+        assert bls.eq(got, bls._py_multiply(p, k % bls.R))
+    pts2 = [bls._py_multiply(bls.G2, rng.getrandbits(32)) for _ in range(3)]
+    ks2 = [rng.getrandbits(255) for _ in range(3)]
+    for got, p, k in zip(nb.g2_mul_batch(pts2, ks2), pts2, ks2):
+        assert bls.eq(got, bls._py_multiply(p, k % bls.R))
+
+
+def test_weighted_sum(rng):
+    pts = [bls._py_multiply(bls.G1, rng.getrandbits(32)) for _ in range(4)]
+    ks = [rng.getrandbits(128) for _ in range(4)]
+    want = bls.infinity(bls.FQ)
+    for p, k in zip(pts, ks):
+        want = bls._py_add(want, bls._py_multiply(p, k))
+    assert bls.eq(nb.g1_weighted_sum(pts, ks), want)
+
+
+def test_subgroup_checks(rng):
+    assert nb.g1_in_subgroup(bls.G1)
+    assert nb.g2_in_subgroup(bls.G2)
+    # a point on E'(Fp2) but outside the r-subgroup: hash to the curve
+    # without cofactor clearing (try-and-increment by hand)
+    ctr = 0
+    while True:
+        raw = bls._expand_message(b"offcurve", b"T" + ctr.to_bytes(4, "big"), 97)
+        x = bls.FQ2([
+            int.from_bytes(raw[0:48], "big"),
+            int.from_bytes(raw[48:96], "big"),
+        ])
+        y = (x * x * x + bls.B2).sqrt()
+        if y is not None:
+            pt = (x, y, bls.FQ2.one())
+            break
+        ctr += 1
+    # overwhelmingly likely to carry a cofactor component
+    assert not nb.g2_in_subgroup(pt)
+    assert not bls.is_inf(bls._py_multiply(pt, bls.R))
+
+
+def test_pairing_checks_match_python(rng):
+    a, b = rng.getrandbits(64), rng.getrandbits(64)
+    pa = nb.g1_mul(bls.G1, a)
+    qb = nb.g2_mul(bls.G2, b)
+    pab = nb.g1_mul(bls.G1, a * b % bls.R)
+    assert nb.pairing_check_eq(pa, qb, pab, bls.G2)
+    assert bls._py_pairing_check_eq(pa, qb, pab, bls.G2)
+    bad = nb.g1_mul(bls.G1, (a * b + 1) % bls.R)
+    assert not nb.pairing_check_eq(pa, qb, bad, bls.G2)
+    assert not bls._py_pairing_check_eq(pa, qb, bad, bls.G2)
+
+
+def test_pairing_product_check_matches_python(rng):
+    # e(aP, Q) e(-P, aQ) == 1
+    a = rng.getrandbits(64)
+    pairs_good = [
+        (nb.g1_mul(bls.G1, a), bls.G2),
+        (bls.neg(bls.G1), nb.g2_mul(bls.G2, a)),
+    ]
+    pairs_bad = [
+        (nb.g1_mul(bls.G1, a + 1), bls.G2),
+        (bls.neg(bls.G1), nb.g2_mul(bls.G2, a)),
+    ]
+    assert nb.pairing_product_check(pairs_good)
+    assert bls._py_pairing_product_check(pairs_good)
+    assert not nb.pairing_product_check(pairs_bad)
+    assert not bls._py_pairing_product_check(pairs_bad)
+
+
+def test_pairing_with_infinity():
+    # infinity entries contribute the identity factor in both engines
+    pairs = [(bls.infinity(bls.FQ), bls.G2)]
+    assert nb.pairing_product_check(pairs)
+    assert bls._py_pairing_product_check(pairs)
+
+
+def test_hash_to_g2_matches_python(rng):
+    for msg in [b"", b"abc", rng.randbytes(33), rng.randbytes(200)]:
+        for dom in [b"HBTPU-G2", b"HBTPU-TE", b"X"]:
+            assert bls.eq(nb.hash_to_g2(msg, dom), bls._py_hash_to_g2(msg, dom))
+
+
+def test_sign_verify_interop(rng):
+    """Signatures made with one engine verify under the other."""
+    sk = th.SecretKey.random(rng)
+    pk = sk.public_key()
+    nb.set_enabled(True)
+    sig_native = sk.sign(b"interop")
+    nb.set_enabled(False)
+    try:
+        sig_python = sk.sign(b"interop")
+        assert sig_native == sig_python
+        assert pk.verify(sig_native, b"interop")  # python verify
+    finally:
+        nb.set_enabled(True)
+    assert pk.verify(sig_python, b"interop")  # native verify
+    assert not pk.verify(sig_native, b"tampered")
+
+
+def test_threshold_stack_cross_engine(rng):
+    """Decryption shares generated natively combine/verify in pure Python."""
+    sks = th.SecretKeySet.random(1, rng)
+    pks = sks.public_keys()
+    ct = pks.public_key().encrypt(b"cross-engine payload", rng)
+    shares = {
+        i: sks.secret_key_share(i).decrypt_share(ct) for i in range(2)
+    }
+    assert pks.decrypt(shares, ct) == b"cross-engine payload"
+    nb.set_enabled(False)
+    try:
+        assert ct.verify()
+        share_py = sks.secret_key_share(0).decrypt_share(ct)
+        assert bls.eq(share_py.point, shares[0].point)
+        assert pks.public_key_share(0).verify_decryption_share(shares[0], ct)
+    finally:
+        nb.set_enabled(True)
+    assert pks.public_key_share(1).verify_decryption_share(shares[1], ct)

@@ -28,6 +28,7 @@ by a relaying proposer.
 """
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Dict, Hashable, List, Optional, Tuple, TypeVar
 
@@ -111,6 +112,9 @@ class _KeyGenState:
     new_ids: list
     new_pub_keys: dict
     key_gen: SyncKeyGen
+    # committed keygen messages in commit order — the public transcript a
+    # stranded joiner replays to derive its secret share (era_transcript)
+    transcript: list = dataclasses.field(default_factory=list)
 
 
 class DynamicHoneyBadger:
@@ -153,6 +157,9 @@ class DynamicHoneyBadger:
         # after each era switch so their era-start proposals aren't lost
         self.future_msgs: List[tuple] = []
         self._just_switched = False
+        # (era, entries) for the most recent era switch: served to stranded
+        # added nodes so they can recover their share (see era_transcript)
+        self.last_transcript: Optional[tuple] = None
 
     # -- construction helpers ----------------------------------------------
 
@@ -260,6 +267,69 @@ class DynamicHoneyBadger:
             pk_set_bytes=self.netinfo.pk_set.to_bytes(),
             session_id=self.session_id,
         )
+
+    def install_share_from_transcript(self, entries) -> bool:
+        """Recover this node's secret share by replaying a committed DKG
+        transcript (stranded-joiner healing, beyond the reference — its
+        join races are fatal, README.md:44-50).
+
+        An added node that could not follow the era switch live (the
+        cluster out-ran it) is a member of the committed validator set
+        but holds no share.  The transcript of Part/Ack messages is
+        committed PUBLIC data: its rows/values are encrypted to each
+        member's long-lived key, so replaying it through our own
+        SyncKeyGen derives exactly the share the live path would have.
+        The result is self-authenticating — accepted only if the derived
+        PublicKeySet equals the adopted JoinPlan's — so the transcript
+        needs no trusted sender.  Returns True iff the share was
+        installed (in place on NetworkInfo, visible to the running HB)."""
+        if self.netinfo.sk_share is not None:
+            return True
+        if self.our_id not in self.netinfo.node_ids:
+            return False
+        threshold = (len(self.netinfo.node_ids) - 1) // 3
+        pub_keys = {
+            nid: self.pub_keys[nid]
+            for nid in self.netinfo.node_ids
+            if nid in self.pub_keys
+        }
+        if len(pub_keys) != len(self.netinfo.node_ids):
+            return False
+        kg = SyncKeyGen(self.our_id, self.our_sk, pub_keys, threshold, self.rng)
+        for proposer, msg in entries:
+            # wire transport delivers ids as raw bytes; logic-tier
+            # callers pass whatever id type the network uses
+            if isinstance(proposer, (bytes, bytearray, memoryview)):
+                proposer = bytes(proposer)
+            # per-entry guard mirroring _commit_keygen_msg: live nodes
+            # fault a malformed committed entry and keep going, so the
+            # replay must skip it too — one Byzantine entry in the
+            # committed transcript must not defeat recovery
+            try:
+                kind = msg[0]
+                if kind == "part":
+                    kg.handle_part(
+                        proposer,
+                        Part(bytes(msg[1]), tuple(bytes(r) for r in msg[2])),
+                    )
+                elif kind == "ack":
+                    kg.handle_ack(
+                        proposer,
+                        Ack(int(msg[1]), tuple(bytes(v) for v in msg[2])),
+                    )
+            except (ValueError, TypeError, KeyError, IndexError):
+                continue
+        try:
+            pk_set, sk_share = kg.generate()
+        except (ValueError, TypeError, KeyError, IndexError):
+            return False
+        if pk_set.to_bytes() != self.netinfo.pk_set.to_bytes():
+            return False  # wrong/forged transcript: reject silently
+        if sk_share is None:
+            return False
+        # in-place: every protocol instance holds this NetworkInfo object
+        self.netinfo.sk_share = sk_share
+        return True
 
     # -- internals ----------------------------------------------------------
 
@@ -422,6 +492,7 @@ class DynamicHoneyBadger:
         state = self.key_gen
         if state is None:
             return  # no active keygen: stale message
+        state.transcript.append((proposer, tuple(kg)))
         try:
             kind = kg[0]
             if kind == "part":
@@ -463,6 +534,7 @@ class DynamicHoneyBadger:
         )
         self.pub_keys = dict(state.new_pub_keys)
         self.era = new_era
+        self.last_transcript = (new_era, tuple(state.transcript))
         self.hb = self._make_hb()
         self.votes = {}
         self.key_gen = None

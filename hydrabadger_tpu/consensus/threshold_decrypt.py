@@ -28,6 +28,7 @@ class ThresholdDecrypt:
         self.ciphertext: Optional[Ciphertext] = None
         self.shares: Dict = {}
         self.pending: Dict = {}  # shares that arrived before the ciphertext
+        self._verified: set = set()  # senders whose shares passed the batch
         self.terminated = False
         self.plaintext: Optional[bytes] = None
 
@@ -63,17 +64,17 @@ class ThresholdDecrypt:
         return self._handle_share(sender, share)
 
     def _handle_share(self, sender, share: DecryptionShare) -> Step:
+        """Share verification is DEFERRED to quorum time: hbbft verifies
+        each share on arrival (2 pairings each); here arriving shares are
+        queued and the whole quorum is checked in one aggregated
+        2-pairing test (engine.verify_decryption_shares_batch), with a
+        per-share fallback attributing faults to exactly the same
+        senders the eager path would have flagged."""
         if self.terminated or sender in self.shares:
             return Step()
         idx = self.netinfo.index(sender)
         if idx is None:
             return Step().fault(sender, "threshold_decrypt: not a validator")
-        if self.verify_shares:
-            pk_share = self.netinfo.pk_set.public_key_share(idx)
-            if not self.engine.verify_decryption_share(
-                pk_share, share, self.ciphertext
-            ):
-                return Step().fault(sender, "threshold_decrypt: invalid share")
         self.shares[sender] = share
         return self._try_decrypt()
 
@@ -81,6 +82,30 @@ class ThresholdDecrypt:
         t = self.netinfo.pk_set.threshold
         if self.terminated or len(self.shares) <= t:
             return Step()
+        step = Step()
+        if self.verify_shares:
+            unverified = [
+                nid for nid in self.shares if nid not in self._verified
+            ]
+            if unverified:
+                oks = self.engine.verify_decryption_shares_batch(
+                    [
+                        self.netinfo.pk_set.public_key_share(
+                            self.netinfo.index(nid)
+                        )
+                        for nid in unverified
+                    ],
+                    [self.shares[nid] for nid in unverified],
+                    self.ciphertext,
+                )
+                for nid, ok in zip(unverified, oks):
+                    if ok:
+                        self._verified.add(nid)
+                    else:
+                        del self.shares[nid]
+                        step.fault(nid, "threshold_decrypt: invalid share")
+            if len(self.shares) <= t:
+                return step
         plaintext = self.engine.combine_decryption_shares(
             self.netinfo.pk_set,
             {self.netinfo.index(nid): s for nid, s in self.shares.items()},
@@ -88,6 +113,5 @@ class ThresholdDecrypt:
         )
         self.terminated = True
         self.plaintext = plaintext
-        step = Step()
         step.output.append(plaintext)
         return step

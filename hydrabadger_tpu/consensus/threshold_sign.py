@@ -33,6 +33,7 @@ class ThresholdSign:
         self.verify_shares = verify_shares
         self.engine = get_engine(engine)
         self.shares: Dict = {}  # node -> SignatureShare
+        self._verified: set = set()  # senders whose shares passed the batch
         self.had_input = False
         self.terminated = False
         self.signature: Optional[Signature] = None
@@ -60,15 +61,15 @@ class ThresholdSign:
         return self._handle_share(sender, share)
 
     def _handle_share(self, sender, share: SignatureShare) -> Step:
+        """Verification is deferred to quorum time and batched: the whole
+        quorum is checked in one aggregated 2-pairing test
+        (engine.verify_signature_shares_batch) instead of 2 pairings per
+        share, with per-share fallback for fault attribution."""
         if self.terminated or sender in self.shares:
             return Step()
         idx = self.netinfo.index(sender)
         if idx is None:
             return Step().fault(sender, "threshold_sign: not a validator")
-        if self.verify_shares and not self.engine.verify_signature_share(
-            self.netinfo.pk_set, idx, share, self.doc
-        ):
-            return Step().fault(sender, "threshold_sign: invalid share")
         self.shares[sender] = share
         return self._try_combine()
 
@@ -76,19 +77,35 @@ class ThresholdSign:
         t = self.netinfo.pk_set.threshold
         if self.terminated or len(self.shares) <= t:
             return Step()
+        step = Step()
+        if self.verify_shares:
+            unverified = [
+                nid for nid in self.shares if nid not in self._verified
+            ]
+            if unverified:
+                oks = self.engine.verify_signature_shares_batch(
+                    self.netinfo.pk_set,
+                    [self.netinfo.index(nid) for nid in unverified],
+                    [self.shares[nid] for nid in unverified],
+                    self.doc,
+                )
+                for nid, ok in zip(unverified, oks):
+                    if ok:
+                        self._verified.add(nid)
+                    else:
+                        del self.shares[nid]
+                        step.fault(nid, "threshold_sign: invalid share")
+            if len(self.shares) <= t:
+                return step
         sig = self.engine.combine_signature_shares(
             self.netinfo.pk_set,
             {self.netinfo.index(nid): s for nid, s in self.shares.items()},
         )
-        if self.verify_shares:
-            # shares were individually verified; combination is sound
-            pass
-        elif not self.engine.verify(
+        if not self.verify_shares and not self.engine.verify(
             self.netinfo.pk_set.public_key(), sig, self.doc
         ):
             # optimistic path failed: a bad share slipped in.  Fall back to
             # verifying shares individually and flagging the culprit(s).
-            step = Step()
             good = {}
             for nid, s in list(self.shares.items()):
                 if self.engine.verify_signature_share(
@@ -104,13 +121,8 @@ class ThresholdSign:
                 self.netinfo.pk_set,
                 {self.netinfo.index(nid): s for nid, s in good.items()},
             )
-            self.terminated = True
-            self.signature = sig
-            step.output.append(sig)
-            return step
         self.terminated = True
         self.signature = sig
-        step = Step()
         step.output.append(sig)
         return step
 

@@ -685,6 +685,8 @@ def g1_from_bytes(raw: bytes):
         raise ValueError("G1 encoding must be 48 bytes")
     if raw[0] & 0x40:
         return infinity(FQ)
+    if _nb.available():
+        return _nb.g1_decompress(raw)
     sign = (raw[0] >> 5) & 1
     xn = int.from_bytes(bytes([raw[0] & 0x1F]) + raw[1:], "big")
     x = FQ(xn)
@@ -730,6 +732,8 @@ def g2_from_bytes(raw: bytes):
         raise ValueError("G2 encoding must be 96 bytes")
     if raw[0] & 0x40:
         return infinity(FQ2)
+    if _nb.available():
+        return _nb.g2_decompress(raw)
     sign = (raw[0] >> 5) & 1
     c1 = int.from_bytes(bytes([raw[0] & 0x1F]) + raw[1:48], "big")
     c0 = int.from_bytes(raw[48:96], "big")
@@ -827,10 +831,9 @@ def pairing_product_check(pairs) -> bool:  # noqa: F811
 # and every verifier of a frame (a coin round hashes one message per
 # node).  Keys are 32-byte digests — never the message bodies, which can
 # be multi-MB wire frames — so memory stays bounded at ~4096 points.
-from collections import OrderedDict  # noqa: E402
+from ..utils.lru import DigestLRU  # noqa: E402
 
-_H_CACHE: "OrderedDict[bytes, tuple]" = OrderedDict()
-_H_CACHE_MAX = 4096
+_H_CACHE: DigestLRU = DigestLRU(4096)
 
 
 def _hash_cache_clear() -> None:
@@ -843,13 +846,10 @@ def hash_to_g2(msg: bytes, domain: bytes = b"HBTPU-G2") -> tuple:  # noqa: F811
     ).digest()
     pt = _H_CACHE.get(key)
     if pt is not None:
-        _H_CACHE.move_to_end(key)
         return pt
     if _nb.available():
         pt = _nb.hash_to_g2(msg, domain)
     else:
         pt = _py_hash_to_g2(msg, domain)
-    _H_CACHE[key] = pt
-    if len(_H_CACHE) > _H_CACHE_MAX:
-        _H_CACHE.popitem(last=False)
+    _H_CACHE.put(key, pt)
     return pt

@@ -72,6 +72,8 @@ def _load():
             ("bls_g2_in_subgroup", [u8p], ctypes.c_int),
             ("bls_g1_on_curve", [u8p], ctypes.c_int),
             ("bls_g2_on_curve", [u8p], ctypes.c_int),
+            ("bls_g1_decompress", [u8p, u8p], ctypes.c_int),
+            ("bls_g2_decompress", [u8p, u8p], ctypes.c_int),
             ("bls_pairing_product_check", [u8p, u8p, i64], ctypes.c_int),
             ("bls_pairing_check_eq", [u8p, u8p, u8p, u8p], ctypes.c_int),
             ("bls_hash_to_g2", [u8p, i64, u8p, i64, u8p], None),
@@ -298,6 +300,22 @@ def _order() -> int:
 # points inside the r-order subgroup.  Cofactor clearing (the one caller
 # with scalars > r on non-subgroup points) goes through g1_mul/g2_mul,
 # which keep the full-width scalar.
+
+
+def g1_decompress(raw: bytes):
+    """48-byte compressed -> projective tuple; curve + subgroup checked."""
+    out = _out(96)
+    if not _load().bls_g1_decompress(_buf(raw), out):
+        raise ValueError("invalid G1 encoding (curve or subgroup check)")
+    return _g1_from_raw(bytes(out))
+
+
+def g2_decompress(raw: bytes):
+    """96-byte compressed -> projective tuple; curve + subgroup checked."""
+    out = _out(192)
+    if not _load().bls_g2_decompress(_buf(raw), out):
+        raise ValueError("invalid G2 encoding (curve or subgroup check)")
+    return _g2_from_raw(bytes(out))
 
 
 def g1_in_subgroup(pt) -> bool:

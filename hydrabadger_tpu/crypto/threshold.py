@@ -23,7 +23,11 @@ import hashlib
 from dataclasses import dataclass
 from typing import Iterable, Mapping, Optional, Sequence
 
+from ..utils.lru import DigestLRU
+
 from . import bls12_381 as bls
+_SIGN_CACHE: "DigestLRU[Signature]" = DigestLRU(1024)
+
 from .bls12_381 import (
     FQ,
     FQ2,
@@ -227,7 +231,19 @@ class SecretKey:
         return PublicKey(mul_sub(G1, self.scalar))
 
     def sign(self, msg: bytes) -> Signature:
-        return Signature(mul_sub(hash_to_g2(msg), self.scalar))
+        # digest-keyed LRU: a broadcast frame is signed once per peer
+        # stream with the identical body (peer.py wire_to_all); dedupe
+        # the G2 ladder for the in-process multi-node runtimes.  Keys are
+        # digests (never message bodies), memory stays bounded.
+        key = hashlib.sha256(
+            self.scalar.to_bytes(32, "big") + hashlib.sha256(msg).digest()
+        ).digest()
+        sig = _SIGN_CACHE.get(key)
+        if sig is not None:
+            return sig
+        sig = Signature(mul_sub(hash_to_g2(msg), self.scalar))
+        _SIGN_CACHE.put(key, sig)
+        return sig
 
     def decrypt(self, ct: "Ciphertext", verify: bool = True) -> Optional[bytes]:
         """Non-threshold decryption by the full key owner.
@@ -339,6 +355,10 @@ class PublicKeySet:
 
     def __init__(self, commitment: Sequence[tuple]):
         self.commitment = list(commitment)
+        # share evaluations are pure in i and requested once per
+        # (verifier, share) pair every epoch — memoize per instance
+        # (consensus cores hold one PublicKeySet for a whole era)
+        self._share_cache: dict = {}
 
     @property
     def threshold(self) -> int:
@@ -348,13 +368,18 @@ class PublicKeySet:
         return PublicKey(self.commitment[0])
 
     def public_key_share(self, i: int) -> PublicKeyShare:
+        cached = self._share_cache.get(i)
+        if cached is not None:
+            return cached
         x = i + 1
         acc = infinity(FQ)
         xk = 1
         for c in self.commitment:
             acc = add(acc, mul_sub(c, xk))
             xk = xk * x % R
-        return PublicKeyShare(acc)
+        share = PublicKeyShare(acc)
+        self._share_cache[i] = share
+        return share
 
     def verify_signature_share(
         self, i: int, share: SignatureShare, msg: bytes

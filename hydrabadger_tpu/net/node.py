@@ -183,6 +183,7 @@ class Hydrabadger:
         self._internal: asyncio.Queue = asyncio.Queue()
         self._dialing: set = set()  # OutAddrs with a connect in flight
         self._tasks: List[asyncio.Task] = []
+        self._share_recovery_task: Optional[asyncio.Task] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._stopped = asyncio.Event()
         self._gen_txns: Optional[Callable[[int, int], List[bytes]]] = None
@@ -562,6 +563,23 @@ class Hydrabadger:
             self._on_key_gen_message(bytes(src_b), tuple(instance_id), payload)
         elif kind == "join_plan":
             self._on_join_plan(msg.payload)
+        elif kind == "era_transcript_request":
+            # serve the committed DKG transcript of our latest era switch
+            # to a stranded added node (public, self-authenticating data)
+            try:
+                want_era = int(msg.payload)
+            except (ValueError, TypeError):
+                log.warning("bad era_transcript_request from %s", peer.out_addr)
+                return
+            if (
+                self.dhb is not None
+                and self.dhb.last_transcript is not None
+                and self.dhb.last_transcript[0] == want_era
+            ):
+                era, entries = self.dhb.last_transcript
+                peer.send(WireMessage("era_transcript", (era, tuple(entries))))
+        elif kind == "era_transcript":
+            self._on_era_transcript(msg.payload)
         elif kind == "net_state_request":
             peer.send(WireMessage("net_state", self._net_state()))
             # a gossiping peer that belongs to the bootstrap validator
@@ -903,8 +921,87 @@ class Hydrabadger:
             q.put_nowait(self.current_epoch)
 
     def _on_join_plan(self, payload) -> None:
+        """Adopt a JoinPlan (batch.join_plan broadcast, handler.rs:692-696).
+
+        Beyond the reference: an OBSERVER whose era is behind the plan's
+        re-adopts the newer snapshot.  Era switches can outrun a joiner —
+        the cluster commits the add-vote and moves to era N+1 while the
+        joiner still digests an era-N plan; the pre-switch epochs it
+        would need to follow the switch are no longer being served, so
+        without the jump it is stranded forever (the reference documents
+        this class of join race as fatal, README.md:44-50).  Every batch
+        carries a fresh plan, so a stranded observer heals on the next
+        batch broadcast.  Validators never re-adopt: they ARE part of
+        the consensus that mints plans."""
         if self.dhb is None:
             self._become_observer(JoinPlan.from_wire(payload))
+            self._maybe_recover_share()
+            return
+        if not self.dhb.is_validator:
+            plan = JoinPlan.from_wire(payload)
+            if plan.era > self.dhb.era:
+                log.info(
+                    "%s observer stranded at era %d; jumping to era %d",
+                    self.uid,
+                    self.dhb.era,
+                    plan.era,
+                )
+                self._become_observer(plan)
+            self._maybe_recover_share()
+
+    def _maybe_recover_share(self) -> None:
+        """If we are a committed member of the current era's validator set
+        but hold no secret share (the era switch out-ran us and we missed
+        the live DKG), start requesting the committed transcript."""
+        d = self.dhb
+        if (
+            d is None
+            or d.netinfo.sk_share is not None
+            or self.uid.bytes not in d.netinfo.node_ids
+        ):
+            return
+        if self._share_recovery_task is None or self._share_recovery_task.done():
+            self._share_recovery_task = asyncio.create_task(
+                self._share_recovery_loop(d.era)
+            )
+            self._tasks.append(self._share_recovery_task)
+
+    async def _share_recovery_loop(self, era: int) -> None:
+        delay = 0.5
+        while True:
+            d = self.dhb
+            if (
+                d is None
+                or d.era != era
+                or d.netinfo.sk_share is not None
+                or self.uid.bytes not in d.netinfo.node_ids
+            ):
+                return
+            self.peers.wire_to_all(
+                WireMessage("era_transcript_request", int(era))
+            )
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 8.0)
+
+    def _on_era_transcript(self, payload) -> None:
+        d = self.dhb
+        if d is None or d.netinfo.sk_share is not None:
+            return
+        try:
+            era, entries = payload
+            era = int(era)
+        except (ValueError, TypeError):
+            return
+        if era != d.era:
+            return
+        if d.install_share_from_transcript(entries):
+            self.state = "validator"
+            log.info(
+                "%s recovered era-%d secret share from committed transcript; "
+                "promoted to validator",
+                self.uid,
+                d.era,
+            )
 
     def _on_disconnect(self, peer: Peer) -> None:
         self.peers.remove(peer)

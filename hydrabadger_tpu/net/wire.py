@@ -46,6 +46,8 @@ KINDS = frozenset(
         "message",
         "key_gen",
         "join_plan",
+        "era_transcript_request",
+        "era_transcript",
         "net_state_request",
         "net_state",
         "transaction",

@@ -1809,6 +1809,82 @@ int bls_pairing_check_eq(const u8* p1, const u8* q1, const u8* p2,
     return bls_pairing_product_check(ps, qs, 2);
 }
 
+// Decompress zcash-style encodings (the Python codec's format): returns 1
+// and writes the affine point on success; 0 if x is not on the curve or
+// the point is outside the r-order subgroup.  Infinity flags are handled
+// by the Python caller.  Values >= P reduce mod P (matching FQ/FQ2).
+static bool fp_sign_raw(const Fp& a) {
+    // raw-value comparison vs (P-1)/2, out of Montgomery form
+    u8 be[48];
+    fp_to_be(be, a);
+    static const auto half = [] {
+        struct Half { u8 be[48]; } h;
+        // (P-1)/2 big-endian: P is odd, shift right by one
+        u64 limbs[6];
+        memcpy(limbs, FP_MOD, sizeof(limbs));
+        limbs[0] -= 1;
+        for (int i = 0; i < 6; i++) {
+            limbs[i] >>= 1;
+            if (i < 5) limbs[i] |= limbs[i + 1] << 63;
+        }
+        for (int i = 0; i < 6; i++) {
+            u64 x = limbs[5 - i];
+            for (int j = 0; j < 8; j++) h.be[i * 8 + j] = u8(x >> (56 - 8 * j));
+        }
+        return h;
+    }();
+    int cmp = memcmp(be, half.be, 48);
+    return cmp > 0;
+}
+
+int bls_g1_decompress(const u8* in48, u8* out96) {
+    u8 xbuf[48];
+    memcpy(xbuf, in48, 48);
+    int sign = (xbuf[0] >> 5) & 1;
+    xbuf[0] &= 0x1F;
+    Fp x, rhs, y, y2, b;
+    fp_from_be(x, xbuf);
+    fp_sqr(rhs, x);
+    fp_mul(rhs, rhs, x);
+    memcpy(b.l, B1_M, sizeof(b.l));
+    fp_add(rhs, rhs, b);
+    fp_sqrt_candidate(y, rhs);
+    fp_sqr(y2, y);
+    if (!fp_eq(y2, rhs)) return 0;
+    if ((fp_sign_raw(y) ? 1 : 0) != sign) fp_neg(y, y);
+    G1A p = {x, y, false};
+    u8 enc[96];
+    g1_store(enc, p);
+    if (!bls_g1_in_subgroup(enc)) return 0;
+    memcpy(out96, enc, 96);
+    return 1;
+}
+
+int bls_g2_decompress(const u8* in96, u8* out192) {
+    // layout: c1 (48, flags in byte 0) || c0 (48)
+    u8 c1buf[48];
+    memcpy(c1buf, in96, 48);
+    int sign = (c1buf[0] >> 5) & 1;
+    c1buf[0] &= 0x1F;
+    Fp2 x, rhs, y, y2, b;
+    fp_from_be(x.c1, c1buf);
+    fp_from_be(x.c0, in96 + 48);
+    fp2_sqr(rhs, x);
+    fp2_mul(rhs, rhs, x);
+    b = load_fp2(B2_M_C0, B2_M_C1);
+    fp2_add(rhs, rhs, b);
+    if (!fp2_sqrt(y, rhs)) return 0;
+    int ysign = fp_is_zero(y.c1) ? (fp_sign_raw(y.c0) ? 1 : 0)
+                                 : (fp_sign_raw(y.c1) ? 1 : 0);
+    if (ysign != sign) fp2_neg(y, y);
+    G2A p = {x, y, false};
+    u8 enc[192];
+    g2_store(enc, p);
+    if (!bls_g2_in_subgroup(enc)) return 0;
+    memcpy(out192, enc, 192);
+    return 1;
+}
+
 // hash_to_g2: bit-identical port of the Python try-and-increment
 // (crypto/bls12_381.py hash_to_g2 / _expand_message)
 static void expand_message(u8* out, i64 n_bytes, const u8* msg, i64 msg_len,

@@ -171,3 +171,67 @@ def test_votes_require_majority():
     for i in ids:
         assert dhbs[i].era == 0
         assert all(b.change is None for b in dhbs[i].batches)
+
+
+def test_stranded_joiner_recovers_share_from_transcript():
+    """An added node that missed the live DKG recovers its secret share by
+    replaying the committed transcript (era_transcript healing): the
+    derived PublicKeySet must match the adopted JoinPlan's, a forged
+    transcript is rejected, and the recovered validator participates."""
+    n = 4
+    ids, id_sks, pub_keys, dhbs = make_cluster(n)
+    rng = random.Random(7)
+    joiner = "n9"
+    joiner_sk = th.SecretKey.random(rng)
+    joiner_pk = joiner_sk.public_key()
+
+    router = Router(ids, lambda me, s, m: dhbs[me].handle_message(s, m))
+    for i in ids:
+        dhbs[i].vote_to_add(joiner, joiner_pk)
+    done = []
+    for _ in range(10):
+        pump_epochs(router, dhbs, rng, 1)
+        done = [
+            b
+            for b in dhbs[ids[0]].batches
+            if b.change and b.change[0] == "complete" and b.join_plan
+        ]
+        if done:
+            break
+    assert done, "add change never completed"
+    plan = done[0].join_plan
+
+    obs = DynamicHoneyBadger.from_join_plan(
+        joiner, joiner_sk, plan, encrypt=False, coin_mode="hash",
+        rng=random.Random(99),
+    )
+    assert not obs.is_validator  # member of the set, but share-less
+
+    # every validator stashed the same committed transcript at the switch
+    era, entries = dhbs[ids[0]].last_transcript
+    assert era == plan.era
+    era2, entries2 = dhbs[ids[1]].last_transcript
+    assert entries2 == entries
+
+    # a forged transcript (rows re-encrypted under a different dealer) is
+    # rejected: the derived pk_set cannot match the plan's
+    forged_rng = random.Random(1234)
+    from hydrabadger_tpu.crypto.dkg import SyncKeyGen as SKG
+
+    forger_keys = {nid: pub_keys.get(nid, joiner_pk) for nid in plan.node_ids}
+    forger = SKG(joiner, joiner_sk, forger_keys, 1, forged_rng)
+    fake_part = forger.propose()
+    forged = [(joiner, ("part", fake_part.commit_bytes, tuple(fake_part.enc_rows)))]
+    assert not obs.install_share_from_transcript(forged)
+    assert obs.netinfo.sk_share is None
+
+    # the genuine transcript installs the share and promotes
+    assert obs.install_share_from_transcript(entries)
+    assert obs.netinfo.sk_share is not None
+    assert obs.is_validator
+
+    # the recovered validator's share is functional: its signature share
+    # verifies under the era's committed PublicKeySet
+    idx = obs.netinfo.our_index()
+    share = obs.netinfo.sk_share.sign_share(b"recovered")
+    assert obs.netinfo.pk_set.verify_signature_share(idx, share, b"recovered")

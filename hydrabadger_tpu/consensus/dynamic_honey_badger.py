@@ -43,6 +43,14 @@ N = TypeVar("N", bound=Hashable)
 MSG = "dhb"
 
 
+def _as_bytes(v) -> bytes:
+    """bytes() on attacker-controlled values must never hit the int
+    overload (bytes(2**31) allocates 2 GB from a 10-byte frame)."""
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return bytes(v)
+    raise ValueError("expected a bytes-like value")
+
+
 def _freeze(value):
     """Hashable canonical form of nested tuples/bytes for dedup matching."""
     if isinstance(value, (list, tuple)):
@@ -310,12 +318,15 @@ class DynamicHoneyBadger:
                 if kind == "part":
                     kg.handle_part(
                         proposer,
-                        Part(bytes(msg[1]), tuple(bytes(r) for r in msg[2])),
+                        Part(
+                            _as_bytes(msg[1]),
+                            tuple(_as_bytes(r) for r in msg[2]),
+                        ),
                     )
                 elif kind == "ack":
                     kg.handle_ack(
                         proposer,
-                        Ack(int(msg[1]), tuple(bytes(v) for v in msg[2])),
+                        Ack(int(msg[1]), tuple(_as_bytes(v) for v in msg[2])),
                     )
             except (ValueError, TypeError, KeyError, IndexError):
                 continue
@@ -496,7 +507,9 @@ class DynamicHoneyBadger:
         try:
             kind = kg[0]
             if kind == "part":
-                part = Part(bytes(kg[1]), tuple(bytes(r) for r in kg[2]))
+                part = Part(
+                    _as_bytes(kg[1]), tuple(_as_bytes(r) for r in kg[2])
+                )
                 outcome = state.key_gen.handle_part(proposer, part)
                 if outcome is None:
                     return
@@ -511,7 +524,7 @@ class DynamicHoneyBadger:
                         )
                     )
             elif kind == "ack":
-                ack = Ack(int(kg[1]), tuple(bytes(v) for v in kg[2]))
+                ack = Ack(int(kg[1]), tuple(_as_bytes(v) for v in kg[2]))
                 outcome = state.key_gen.handle_ack(proposer, ack)
                 if outcome is not None and not outcome.valid:
                     step.fault(proposer, f"dhb keygen: {outcome.fault}")
@@ -567,23 +580,44 @@ class _RemovedTracker:
         if sender_id not in self.new_ids:
             return PartOutcome(False, fault="part from non-member")
         idx = self.new_ids.index(sender_id)
-        if idx not in self.commitments:
-            self.commitments[idx] = BivarCommitment.from_bytes(part.commit_bytes)
-            self.ack_counts[idx] = set()
+        # the same STRUCTURAL checks SyncKeyGen applies — the leaver's
+        # recorded proposal set must match the validators' exactly or the
+        # era-switch gate fires at different committed batches
+        try:
+            commit = BivarCommitment.from_bytes(part.commit_bytes)
+        except (ValueError, TypeError):
+            return PartOutcome(False, fault="undecodable commitment")
+        if commit.t != self.threshold:
+            return PartOutcome(False, fault="wrong degree")
+        if len(part.enc_rows) != len(self.new_ids):
+            return PartOutcome(False, fault="wrong row count")
+        if idx in self.commitments:
+            if self.commitments[idx].to_bytes() != part.commit_bytes:
+                return PartOutcome(False, fault="conflicting part")
+            return PartOutcome(True)
+        self.commitments[idx] = commit
+        self.ack_counts[idx] = set()
         return PartOutcome(True)
 
     def handle_ack(self, sender_id, ack: Ack):
         from ..crypto.dkg import AckOutcome
 
-        if ack.proposer_idx in self.ack_counts and sender_id in self.new_ids:
-            self.ack_counts[ack.proposer_idx].add(sender_id)
+        if ack.proposer_idx not in self.ack_counts:
+            return AckOutcome(False, fault="ack for unknown part")
+        if sender_id not in self.new_ids:
+            return AckOutcome(False, fault="ack from non-member")
+        if len(ack.enc_values) != len(self.new_ids):
+            return AckOutcome(False, fault="wrong value count")
+        self.ack_counts[ack.proposer_idx].add(sender_id)
         return AckOutcome(True)
 
     def _complete(self):
+        # 2t+1 structural acks — the same objective gate as
+        # _ProposalState.is_complete, so leaver and validators agree
         return [
             i
             for i in sorted(self.commitments)
-            if len(self.ack_counts.get(i, ())) > self.threshold
+            if len(self.ack_counts.get(i, ())) > 2 * self.threshold
         ]
 
     def count_complete(self) -> int:

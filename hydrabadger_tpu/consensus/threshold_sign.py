@@ -116,6 +116,8 @@ class ThresholdSign:
                     del self.shares[nid]
                     step.fault(nid, "threshold_sign: invalid share")
             if len(good) <= t:
+                # not enough verified shares left: stay live and wait
+                # for more instead of terminating on a bogus combine
                 return step
             sig = self.engine.combine_signature_shares(
                 self.netinfo.pk_set,

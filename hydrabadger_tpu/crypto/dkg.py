@@ -158,6 +158,9 @@ class PartOutcome:
     valid: bool
     ack: Optional[Ack] = None
     fault: Optional[str] = None
+    # the part was recorded despite a node-local (own-row) fault: the
+    # proposal set stays objective while the proposer is still faulted
+    recorded: bool = False
 
 
 @dataclass
@@ -174,7 +177,15 @@ class _ProposalState:
     acks: set = field(default_factory=set)
 
     def is_complete(self, threshold: int) -> bool:
-        return len(self.values) > threshold
+        """OBJECTIVE completion: counts structurally-valid acks, which are
+        identical on every node processing the same committed transcript
+        (node-local decryption results must never influence this, or a
+        Byzantine acker could split the era-switch gate across honest
+        nodes — different nodes would switch eras at different epochs, a
+        permanent fork).  2t+1 acks guarantee >= t+1 honest ackers whose
+        values verify for EVERY recipient, so each node can derive its
+        share (hbbft sync_key_gen's node_ready threshold)."""
+        return len(self.acks) > 2 * threshold
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +240,19 @@ class SyncKeyGen(Generic[N]):
         return self.node_ids.index(node_id)
 
     def handle_part(self, sender_id: N, part: Part) -> PartOutcome:
+        """Record a proposal.
+
+        Checks split into two classes with different consequences:
+        STRUCTURAL checks (decodable commitment, degree, row count,
+        first-commit-wins conflicts) depend only on the committed bytes
+        — every honest node rejects identically, so a structurally bad
+        part is never recorded anywhere.  OWN-ROW checks (our encrypted
+        row decrypts and matches the commitment) are node-local: a
+        Byzantine proposer can make them fail for a targeted subset of
+        nodes, so their failure must NOT change the recorded proposal
+        set — the part is recorded (completion stays objective), the
+        proposer is faulted, and we simply do not ack.  A victim still
+        derives its share from t+1 honest ackers' values."""
         s = self.node_index(sender_id)
         if s in self.parts:
             existing = self.parts[s]
@@ -243,21 +267,26 @@ class SyncKeyGen(Generic[N]):
             return PartOutcome(False, fault="wrong degree")
         if len(part.enc_rows) != len(self.node_ids):
             return PartOutcome(False, fault="wrong row count")
+        row: Optional[List[int]] = None
+        fault = None
         try:
             ct = Ciphertext.from_bytes(part.enc_rows[self.our_idx])
             raw = self.our_sk.decrypt(ct, verify=False)
             row = [int(c) % R for c in codec.decode(raw)]
         except (ValueError, TypeError):
-            return PartOutcome(False, fault="undecryptable row")
-        if len(row) != self.threshold + 1:
-            return PartOutcome(False, fault="wrong row degree")
-        # verify our row against the commitment
-        expected = commit.row_commitment(self.our_idx + 1)
-        for k, coeff in enumerate(row):
-            if not eq(mul_sub(G1, coeff), expected[k]):
-                return PartOutcome(False, fault="row/commitment mismatch")
+            fault = "undecryptable row"
+        if row is not None and len(row) != self.threshold + 1:
+            row, fault = None, "wrong row degree"
+        if row is not None:
+            expected = commit.row_commitment(self.our_idx + 1)
+            for k, coeff in enumerate(row):
+                if not eq(mul_sub(G1, coeff), expected[k]):
+                    row, fault = None, "row/commitment mismatch"
+                    break
         state = _ProposalState(commit, row=row)
         self.parts[s] = state
+        if row is None:
+            return PartOutcome(False, fault=fault, recorded=True)
         # our own consistent value: f_s(our_idx+1, our_idx+1)
         enc_values = []
         for m, nid in enumerate(self.node_ids):
@@ -270,6 +299,12 @@ class SyncKeyGen(Generic[N]):
         return PartOutcome(True, ack=Ack(s, tuple(enc_values)))
 
     def handle_ack(self, sender_id: N, ack: Ack) -> AckOutcome:
+        """Count an ack.  STRUCTURAL checks (known part, value count,
+        duplicates) are objective and gate the count; OWN-SLOT checks
+        (our encrypted value decrypts and matches the commitment) are
+        node-local and must not — the ack still counts toward the
+        era-switch gate (see _ProposalState.is_complete), the sender is
+        faulted, and the bad value is simply not stored."""
         m = self.node_index(sender_id)
         if ack.proposer_idx not in self.parts:
             return AckOutcome(False, fault="ack for unknown part")
@@ -278,6 +313,7 @@ class SyncKeyGen(Generic[N]):
             return AckOutcome(True)  # duplicate
         if len(ack.enc_values) != len(self.node_ids):
             return AckOutcome(False, fault="wrong value count")
+        state.acks.add(m)
         try:
             ct = Ciphertext.from_bytes(ack.enc_values[self.our_idx])
             raw = self.our_sk.decrypt(ct, verify=False)
@@ -288,7 +324,6 @@ class SyncKeyGen(Generic[N]):
         expected = state.commitment.evaluate(m + 1, self.our_idx + 1)
         if not eq(mul_sub(G1, val), expected):
             return AckOutcome(False, fault="value/commitment mismatch")
-        state.acks.add(m)
         state.values[m + 1] = val
         return AckOutcome(True)
 
@@ -316,6 +351,14 @@ class SyncKeyGen(Generic[N]):
                 continue
             row0 = state.commitment.row_commitment(0)
             commit_acc = [add(a, b) for a, b in zip(commit_acc, row0)]
+            # interpolate our share slice from VERIFIED ack values only;
+            # 2t+1 structural acks guarantee >= t+1 of them carried
+            # values that verify for us (honest ackers)
+            if len(state.values) <= t:
+                raise ValueError(
+                    "complete proposal with insufficient verified values "
+                    "(more than t Byzantine ackers?)"
+                )
             pts = dict(list(state.values.items())[: t + 1])
             sk_val = (sk_val + poly_interpolate_at_zero(pts)) % R
         return PublicKeySet(commit_acc), SecretKeyShare(sk_val)

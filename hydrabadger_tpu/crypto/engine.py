@@ -163,20 +163,13 @@ class CpuEngine:
             return [bool(v) for v in verdicts]
         # Fiat-Shamir coefficients over the full batch: an adversary must
         # fix all items before learning any r_i
-        h = hashlib.sha256()
-        for pk, sig, msg in sub:
-            h.update(pk.to_bytes())
-            h.update(sig.to_bytes())
-            h.update(hashlib.sha256(msg).digest())
-        seed = h.digest()
-        rs = [
-            int.from_bytes(
-                hashlib.sha256(seed + i.to_bytes(4, "big")).digest()[:16],
-                "big",
-            )
-            | 1  # never zero
-            for i in range(n)
-        ]
+        rs = self._rlc_coeffs(
+            [
+                pk.to_bytes() + sig.to_bytes() + hashlib.sha256(msg).digest()
+                for pk, sig, msg in sub
+            ],
+            n,
+        )
         agg_sig = bls.infinity(bls.FQ2)
         for (pk, sig, msg), r in zip(sub, rs):
             agg_sig = bls.add(agg_sig, bls.mul_sub(sig.point, r))

@@ -102,8 +102,12 @@ def set_enabled(flag: bool) -> None:
     global _ENABLED
     _ENABLED = bool(flag)
     from . import bls12_381 as bls
+    from . import engine as _eng
+    from . import threshold as _th
 
     bls._hash_cache_clear()
+    _th._SIGN_CACHE.clear()
+    _eng._VERIFIED_FRAMES.clear()
 
 
 def _buf(raw: bytes):

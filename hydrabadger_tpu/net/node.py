@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
@@ -50,6 +51,13 @@ PARKED_BYTES_CAP = 4 * 1024 * 1024
 # replay; retry storms re-send the transcript every tick, so membership
 # checks must be O(1) (a set mirrors the ordered list).
 KEYGEN_INBOX_CAP = 4096
+# Targeted-frame retry queue (the reference retries undeliverable
+# targeted messages up to 10 times: handler.rs:660-670, peer.rs:581-600,
+# cap at mod.rs:17).  HBBFT assumes reliable delivery; a targeted RBC
+# shard to a momentarily-unconnected peer must not be silently dropped.
+WIRE_RETRY_CAP = 10
+WIRE_RETRY_MAX_QUEUE = 4096
+WIRE_RETRY_TICK_S = 0.25
 
 
 @dataclass
@@ -101,7 +109,7 @@ class KeyGenMachine:
 
     def handle_part(self, sender, part: Part):
         outcome = self.kg.handle_part(sender, part)
-        if outcome.valid:
+        if outcome.valid or outcome.recorded:
             self._drain_pending_acks()
         return outcome
 
@@ -184,6 +192,8 @@ class Hydrabadger:
         self._dialing: set = set()  # OutAddrs with a connect in flight
         self._tasks: List[asyncio.Task] = []
         self._share_recovery_task: Optional[asyncio.Task] = None
+        self._wire_retry: deque = deque()  # (uid, msg, attempts)
+        self._transcript_served: Dict[OutAddr, float] = {}  # rate limiting
         self._server: Optional[asyncio.base_events.Server] = None
         self._stopped = asyncio.Event()
         self._gen_txns: Optional[Callable[[int, int], List[bytes]]] = None
@@ -279,6 +289,7 @@ class Hydrabadger:
         )
         self._tasks.append(asyncio.create_task(self._handler_loop()))
         self._tasks.append(asyncio.create_task(self._keygen_retry_loop()))
+        self._tasks.append(asyncio.create_task(self._wire_retry_loop()))
         if gen_txns is not None:
             self._tasks.append(asyncio.create_task(self._generator_loop()))
         for remote in remotes or []:
@@ -565,7 +576,9 @@ class Hydrabadger:
             self._on_join_plan(msg.payload)
         elif kind == "era_transcript_request":
             # serve the committed DKG transcript of our latest era switch
-            # to a stranded added node (public, self-authenticating data)
+            # to a stranded added node (public, self-authenticating data).
+            # Per-peer cooldown: the transcript is O(n^2) ciphertexts, so
+            # repeat requests must not become a bandwidth amplifier.
             try:
                 want_era = int(msg.payload)
             except (ValueError, TypeError):
@@ -576,6 +589,11 @@ class Hydrabadger:
                 and self.dhb.last_transcript is not None
                 and self.dhb.last_transcript[0] == want_era
             ):
+                now = asyncio.get_event_loop().time()
+                last = self._transcript_served.get(peer.out_addr, 0.0)
+                if now - last < 3.0:
+                    return
+                self._transcript_served[peer.out_addr] = now
                 era, entries = self.dhb.last_transcript
                 peer.send(WireMessage("era_transcript", (era, tuple(entries))))
         elif kind == "era_transcript":
@@ -892,7 +910,9 @@ class Hydrabadger:
             msg = wire.consensus_message(self.uid, tm.message)
             if tm.target.kind == "nodes":
                 for nid in tm.target.nodes:
-                    self.peers.wire_to(Uid(bytes(nid)), msg)
+                    uid = Uid(bytes(nid))
+                    if not self.peers.wire_to(uid, msg):
+                        self._queue_wire_retry(uid, msg)
             else:
                 # all / all_except: broadcast (observers need the traffic
                 # too — deliberately mirrors the reference, peer.rs:567)
@@ -968,6 +988,7 @@ class Hydrabadger:
 
     async def _share_recovery_loop(self, era: int) -> None:
         delay = 0.5
+        rr = 0
         while True:
             d = self.dhb
             if (
@@ -977,9 +998,15 @@ class Hydrabadger:
                 or self.uid.bytes not in d.netinfo.node_ids
             ):
                 return
-            self.peers.wire_to_all(
-                WireMessage("era_transcript_request", int(era))
-            )
+            # one peer per tick (round-robin): every eligible validator
+            # holds the same transcript, n redundant multi-MB replies
+            # per tick would be pure waste
+            established = list(self.peers.established())
+            if established:
+                established[rr % len(established)].send(
+                    WireMessage("era_transcript_request", int(era))
+                )
+                rr += 1
             await asyncio.sleep(delay)
             delay = min(delay * 1.5, 8.0)
 
@@ -1014,6 +1041,38 @@ class Hydrabadger:
         ):
             # vote the dead validator out (handler.rs:397-426)
             self.dhb.vote_to_remove(peer.uid.bytes)
+
+    def _queue_wire_retry(self, uid: Uid, msg: WireMessage) -> None:
+        """Park an undeliverable targeted frame for the retry tick
+        (handler.rs:660-670 semantics; bounded, oldest dropped first)."""
+        if len(self._wire_retry) >= WIRE_RETRY_MAX_QUEUE:
+            self._wire_retry.popleft()
+        self._wire_retry.append((uid, msg, 0))
+
+    async def _wire_retry_loop(self) -> None:
+        """Re-attempt targeted frames to not-yet/re-connected peers.
+
+        The reference drains its SegQueue of (target, message, retries)
+        each handler poll and re-queues failures up to 10 attempts
+        (handler.rs:660-670, peer.rs:581-600, cap mod.rs:17); here a
+        timed tick drains ours so a link flap mid-epoch does not lose
+        RBC shards the protocol assumes delivered."""
+        while True:
+            await asyncio.sleep(WIRE_RETRY_TICK_S)
+            if not self._wire_retry:
+                continue
+            pending, self._wire_retry = self._wire_retry, deque()
+            for uid, msg, attempts in pending:
+                if self.peers.wire_to(uid, msg):
+                    continue
+                if attempts + 1 < WIRE_RETRY_CAP:
+                    self._wire_retry.append((uid, msg, attempts + 1))
+                else:
+                    log.warning(
+                        "dropping targeted frame to %s after %d attempts",
+                        uid,
+                        WIRE_RETRY_CAP,
+                    )
 
     async def _keygen_retry_loop(self) -> None:
         """Bootstrap liveness: gossip + re-broadcast until DKG completes.

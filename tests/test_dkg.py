@@ -4,7 +4,7 @@ import random
 import pytest
 
 from hydrabadger_tpu.crypto import threshold as th
-from hydrabadger_tpu.crypto.dkg import BivarPoly, SyncKeyGen
+from hydrabadger_tpu.crypto.dkg import Ack, BivarPoly, SyncKeyGen
 
 
 def run_dkg(n, t, seed=7, drop_proposer=None):
@@ -87,3 +87,68 @@ def test_corrupt_part_rejected():
     tampered = type(part)(part.commit_bytes, (part.enc_rows[1], part.enc_rows[0]) + part.enc_rows[2:])
     out = kg_b.handle_part("a", tampered)
     assert not out.valid
+
+
+def test_ack_completion_counting_is_objective():
+    """The era-switch gate's per-proposal completion must depend only on
+    committed structural data, never on node-local decryption: a
+    Byzantine acker whose enc_values decrypt for some nodes and not
+    others must not make honest nodes disagree on count_complete().
+
+    Under the pre-fix subjective counting (values > t), the schedule
+    below splits the network: after proposer's own ack plus the targeted
+    Byzantine ack, the victim counts 1 value while everyone else counts
+    2 — one side fires the era-switch gate, the other does not."""
+    rng = random.Random(5)
+    ids = ["a", "b", "c", "d"]
+    sks = {i: th.SecretKey.random(rng) for i in ids}
+    pks = {i: sks[i].public_key() for i in ids}
+    t = 1
+    kgs = {
+        i: SyncKeyGen(i, sks[i], pks, t, random.Random(100 + k))
+        for k, i in enumerate(ids)
+    }
+    victim, byz, proposer = "d", "c", "a"
+
+    part = kgs[proposer].propose()
+    acks = {}
+    for i in ids:
+        out = kgs[i].handle_part(proposer, part)
+        assert out.valid
+        acks[i] = out.ack
+
+    # the Byzantine acker garbles exactly the victim's slot
+    vslot = sorted(ids).index(victim)
+    vals = list(acks[byz].enc_values)
+    vals[vslot] = b"\xde\xad" * 50
+    bad_ack = Ack(acks[byz].proposer_idx, tuple(vals))
+
+    # committed order: proposer's own ack, then the Byzantine ack
+    for i in ids:
+        assert kgs[i].handle_ack(proposer, acks[proposer]).valid
+    for i in ids:
+        out = kgs[i].handle_ack(byz, bad_ack)
+        if i == victim:
+            assert not out.valid and out.fault == "undecryptable value"
+        else:
+            assert out.valid
+
+    # OBJECTIVE: every node agrees on completion at this point (2 acks
+    # is not > 2t, so nobody fires yet — no split either way)
+    counts = {i: kgs[i].count_complete() for i in ids}
+    assert len(set(counts.values())) == 1, counts
+
+    # a second honest ack completes the proposal for everyone at once
+    for i in ids:
+        kgs[i].handle_ack("b", acks["b"])
+    counts = {i: kgs[i].count_complete() for i in ids}
+    assert set(counts.values()) == {1}, counts
+
+    # the victim, missing the Byzantine value, still derives a share
+    # that verifies against the common commitment
+    pk_set_v, share_v = kgs[victim].generate()
+    pk_set_o, share_o = kgs["b"].generate()
+    assert pk_set_v.to_bytes() == pk_set_o.to_bytes()
+    vidx = sorted(ids).index(victim)
+    sig = share_v.sign_share(b"objective")
+    assert pk_set_v.verify_signature_share(vidx, sig, b"objective")

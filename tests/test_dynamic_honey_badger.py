@@ -235,3 +235,209 @@ def test_stranded_joiner_recovers_share_from_transcript():
     idx = obs.netinfo.our_index()
     share = obs.netinfo.sk_share.sign_share(b"recovered")
     assert obs.netinfo.pk_set.verify_signature_share(idx, share, b"recovered")
+
+
+def _pump_until(router, dhbs, rng, pred, max_epochs=12):
+    for _ in range(max_epochs):
+        pump_epochs(router, dhbs, rng, 1)
+        if pred():
+            return True
+    return False
+
+
+def _switch_points(dhbs):
+    """(era, epoch) of each node's completed-change batch."""
+    out = {}
+    for i, d in dhbs.items():
+        done = [b for b in d.batches if b.change and b.change[0] == "complete"]
+        out[i] = [(b.era, b.epoch) for b in done]
+    return out
+
+
+def test_byzantine_ack_cannot_split_era_switch_gate():
+    """A Byzantine acker crafts enc_values that decrypt for some honest
+    nodes and not others.  Completion counting is OBJECTIVE (structural
+    acks only), so every honest node fires the era-switch gate at the
+    same committed batch; victims still derive functional shares from
+    the >= t+1 honest ackers."""
+    n = 6
+    ids, id_sks, pub_keys, dhbs = make_cluster(n)
+    rng = random.Random(21)
+    joiner = "n9"
+    joiner_sk = th.SecretKey.random(rng)
+    router = Router(ids, lambda me, s, m: dhbs[me].handle_message(s, m))
+    for i in ids:
+        dhbs[i].vote_to_add(joiner, joiner_sk.public_key())
+
+    byz = ids[0]
+    victims = set(ids[3:])  # slots whose enc_values the byz acker garbles
+    corrupted = {"n": 0}
+
+    def corrupt_pending_acks():
+        d = dhbs[byz]
+        if d.key_gen is None:
+            return
+        new_ids = sorted(d.key_gen.new_ids)
+        for k, msg in enumerate(d.pending_kg):
+            if msg[0] != "ack":
+                continue
+            vals = list(msg[2])
+            changed = False
+            for v in victims:
+                slot = new_ids.index(v)
+                if vals[slot] != b"\xde\xad" * 60:
+                    vals[slot] = b"\xde\xad" * 60  # undecodable ciphertext
+                    changed = True
+            if changed:
+                d.pending_kg[k] = (msg[0], msg[1], tuple(vals))
+                corrupted["n"] += 1
+
+    # drive epoch by epoch, corrupting the byz node's outgoing acks
+    switched = False
+    for _ in range(14):
+        corrupt_pending_acks()
+        pump_epochs(router, dhbs, rng, 1)
+        corrupt_pending_acks()
+        if all(
+            any(b.change and b.change[0] == "complete" for b in d.batches)
+            for d in dhbs.values()
+        ):
+            switched = True
+            break
+    assert switched, "era switch never completed"
+    assert corrupted["n"] > 0, "the attack never fired"
+
+    # the gate fired at ONE committed batch for every honest node
+    points = _switch_points(dhbs)
+    assert len({tuple(v) for v in points.values()}) == 1, points
+
+    # all nodes agree on the new era's public key set
+    pk_sets = {d.netinfo.pk_set.to_bytes() for d in dhbs.values()}
+    assert len(pk_sets) == 1
+
+    # victims derived working shares despite the garbled ack values
+    for v in victims:
+        d = dhbs[v]
+        assert d.netinfo.sk_share is not None
+        idx = d.netinfo.our_index()
+        share = d.netinfo.sk_share.sign_share(b"post-attack")
+        assert d.netinfo.pk_set.verify_signature_share(idx, share, b"post-attack")
+
+    # the byz acker was faulted by the victims (undecryptable value)
+    # and the network still reaches agreement afterwards
+    pump_epochs(router, dhbs, rng, 2)
+    last = {i: d.batches[-1] for i, d in dhbs.items()}
+    assert len({tuple(sorted(b.contributions.items())) for b in last.values()}) == 1
+
+
+def test_byzantine_part_rows_cannot_split_proposal_set():
+    """A Byzantine proposer garbles the encrypted rows of a targeted
+    subset.  The part is structurally valid so EVERY node records it
+    (objective proposal set); victims fault the proposer and do not ack,
+    but still derive their shares from honest ackers' values."""
+    n = 6
+    ids, id_sks, pub_keys, dhbs = make_cluster(n)
+    rng = random.Random(22)
+    joiner = "n9"
+    joiner_sk = th.SecretKey.random(rng)
+    router = Router(ids, lambda me, s, m: dhbs[me].handle_message(s, m))
+    for i in ids:
+        dhbs[i].vote_to_add(joiner, joiner_sk.public_key())
+
+    byz = ids[1]
+    victim = ids[4]
+    fired = {"n": 0}
+
+    def corrupt_pending_part():
+        d = dhbs[byz]
+        if d.key_gen is None:
+            return
+        new_ids = sorted(d.key_gen.new_ids)
+        slot = new_ids.index(victim)
+        for k, msg in enumerate(d.pending_kg):
+            if msg[0] != "part":
+                continue
+            rows = list(msg[2])
+            if rows[slot] != b"\xbb" * 180:
+                rows[slot] = b"\xbb" * 180
+                d.pending_kg[k] = (msg[0], msg[1], tuple(rows))
+                fired["n"] += 1
+
+    switched = False
+    for _ in range(14):
+        corrupt_pending_part()
+        pump_epochs(router, dhbs, rng, 1)
+        corrupt_pending_part()
+        if all(
+            any(b.change and b.change[0] == "complete" for b in d.batches)
+            for d in dhbs.values()
+        ):
+            switched = True
+            break
+    assert switched, "era switch never completed"
+    assert fired["n"] > 0
+
+    points = _switch_points(dhbs)
+    assert len({tuple(v) for v in points.values()}) == 1, points
+    pk_sets = {d.netinfo.pk_set.to_bytes() for d in dhbs.values()}
+    assert len(pk_sets) == 1
+
+    # the victim (bad row) still has a functional share
+    d = dhbs[victim]
+    assert d.netinfo.sk_share is not None
+    idx = d.netinfo.our_index()
+    share = d.netinfo.sk_share.sign_share(b"row-attack")
+    assert d.netinfo.pk_set.verify_signature_share(idx, share, b"row-attack")
+
+
+def test_leaver_tracker_matches_validators_under_bad_part():
+    """A structurally invalid part (wrong commitment degree) committed
+    during a removal keygen is rejected by validators AND by the leaving
+    node's _RemovedTracker, so both fire the era switch at the same
+    committed batch and derive the same PublicKeySet."""
+    from hydrabadger_tpu.crypto.dkg import BivarPoly
+
+    n = 4
+    ids, id_sks, pub_keys, dhbs = make_cluster(n)
+    rng = random.Random(23)
+    leaver = ids[3]
+    router = Router(ids, lambda me, s, m: dhbs[me].handle_message(s, m))
+    for i in ids:
+        dhbs[i].vote_to_remove(leaver)
+
+    byz = ids[2]
+    fired = {"n": 0}
+
+    def inject_bad_part():
+        d = dhbs[byz]
+        if d.key_gen is None or fired["n"]:
+            return
+        new_n = len(d.key_gen.new_ids)
+        bad_t = (new_n - 1) // 3 + 1  # wrong degree
+        poly = BivarPoly.random(bad_t, random.Random(999))
+        commit = poly.commitment().to_bytes()
+        rows = tuple(b"\x01" * 40 for _ in range(new_n))
+        d.pending_kg.append(("part", commit, rows))
+        fired["n"] += 1
+
+    switched = False
+    for _ in range(14):
+        inject_bad_part()
+        pump_epochs(router, dhbs, rng, 1)
+        if all(
+            any(b.change and b.change[0] == "complete" for b in d.batches)
+            for d in dhbs.values()
+        ):
+            switched = True
+            break
+    assert switched, "era switch never completed"
+    assert fired["n"] > 0
+
+    # every node — INCLUDING the leaver following via _RemovedTracker —
+    # fired the switch at the same batch with the same new pk_set
+    points = _switch_points(dhbs)
+    assert len({tuple(v) for v in points.values()}) == 1, points
+    pk_sets = {d.netinfo.pk_set.to_bytes() for d in dhbs.values()}
+    assert len(pk_sets) == 1
+    assert not dhbs[leaver].is_validator
+    assert leaver not in dhbs[ids[0]].netinfo.node_ids

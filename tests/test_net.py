@@ -11,7 +11,7 @@ import pytest
 from hydrabadger_tpu.net.node import Config, Hydrabadger
 from hydrabadger_tpu.net.wire import WireMessage
 from hydrabadger_tpu.utils import codec
-from hydrabadger_tpu.utils.ids import InAddr, OutAddr
+from hydrabadger_tpu.utils.ids import InAddr, OutAddr, Uid
 
 BASE_PORT = 43700
 
@@ -262,3 +262,60 @@ async def test_restart_world_from_checkpoints_over_tcp():
         assert all(n.batches[0].epoch >= top for n in restored)
     finally:
         await stop_cluster(restored)
+
+
+@pytest.mark.asyncio
+async def test_wire_retry_queue_redelivers_targeted_frames():
+    """A targeted consensus frame to a momentarily-unconnected peer is
+    parked and retried (handler.rs:660-670 semantics, cap 10) instead of
+    silently dropped — HBBFT assumes reliable delivery."""
+    from hydrabadger_tpu.consensus.types import Step, Target, TargetedMessage
+    from hydrabadger_tpu.net.node import WIRE_RETRY_CAP
+
+    node = Hydrabadger(InAddr("127.0.0.1", BASE_PORT + 90), fast_config(), seed=1)
+    target_uid = Uid()
+    delivered = []
+    attempts = {"n": 0}
+
+    def flaky_wire_to(uid, msg):
+        attempts["n"] += 1
+        if attempts["n"] < 3:  # link down for the first attempts
+            return False
+        delivered.append((uid, msg))
+        return True
+
+    node.peers.wire_to = flaky_wire_to
+    step = Step()
+    step.messages.append(
+        TargetedMessage(Target.node(target_uid.bytes), ("m", 1))
+    )
+    node._dispatch_step(step)
+    assert not delivered, "first attempt should have failed"
+    assert len(node._wire_retry) == 1
+
+    task = asyncio.create_task(node._wire_retry_loop())
+    try:
+        for _ in range(40):
+            await asyncio.sleep(0.1)
+            if delivered:
+                break
+        assert delivered, "retry loop never redelivered the frame"
+        assert delivered[0][0].bytes == target_uid.bytes
+        assert not node._wire_retry
+    finally:
+        task.cancel()
+
+    # cap: a permanently dead target is dropped after WIRE_RETRY_CAP tries
+    attempts["n"] = -10**9  # always fail
+    delivered.clear()
+    node._dispatch_step(step)
+    task = asyncio.create_task(node._wire_retry_loop())
+    try:
+        for _ in range(60):
+            await asyncio.sleep(0.1)
+            if not node._wire_retry:
+                break
+        assert not node._wire_retry, "capped frame should be dropped"
+        assert not delivered
+    finally:
+        task.cancel()

@@ -347,6 +347,64 @@ def _tensor_epochs_config6(instances: int, epochs: int) -> dict:
     }
 
 
+def _verified_shares_config7(batch: int) -> dict:
+    """Config 7 (round 2): verified decryption shares/sec.
+
+    The pairing side of SURVEY.md §2.2 row 2 — every share of the
+    reference's hot loop is pairing-verified (hbbft::threshold_decrypt
+    via state.rs:487).  Three tiers measured honestly:
+      - per-share native C++ pairing checks (the reference-parity host
+        path, crypto/native_bls),
+      - TPU batched pairing lanes (ops/pairing_jax): B independent
+        e(S_i, H_i) == e(pk_i, W_i) checks in one XLA program.
+    vs_baseline is TPU vs the native per-share loop.  (Round 1's pure-
+    Python baseline, ~3.3 shares/s, is what both replaced.)
+    """
+    import random
+
+    from hydrabadger_tpu.crypto import threshold as th
+    from hydrabadger_tpu.crypto.engine import CpuEngine, TpuEngine
+
+    rng = random.Random(7)
+    cpu, tpu = CpuEngine(), TpuEngine()
+    sks = th.SecretKeySet.random(1, rng)
+    pks = sks.public_keys()
+    cts, shares, pk_shares = [], [], []
+    for i in range(batch):
+        ct = pks.public_key().encrypt(b"%032d" % i, rng)
+        cts.append(ct)
+        shares.append(sks.secret_key_share(i % 2).decrypt_share(ct))
+        pk_shares.append(pks.public_key_share(i % 2))
+
+    from hydrabadger_tpu.crypto import native_bls
+
+    host_tier = "native" if native_bls.available() else "python"
+    n_native = min(32, batch)
+    t0 = time.perf_counter()
+    for pk, s, ct in zip(pk_shares[:n_native], shares[:n_native], cts[:n_native]):
+        assert cpu.verify_decryption_share(pk, s, ct)
+    native_sps = n_native / (time.perf_counter() - t0)
+
+    # warm (compile), then measure steady state
+    tpu.verify_decryption_share_pairs(pk_shares, shares, cts)
+    t0 = time.perf_counter()
+    oks = tpu.verify_decryption_share_pairs(pk_shares, shares, cts)
+    accel_sps = batch / (time.perf_counter() - t0)
+    assert all(oks)
+
+    import jax
+
+    return {
+        "metric": (
+            f"verified_dec_shares_per_sec_batch{batch}_"
+            f"{jax.default_backend()}_vs_{host_tier}_host"
+        ),
+        "value": round(accel_sps, 1),
+        "unit": "shares/s",
+        "vs_baseline": round(accel_sps / native_sps, 2) if native_sps else 0.0,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -354,14 +412,15 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6],
+        choices=[1, 2, 3, 4, 5, 6, 7],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
         "BLS ThresholdDecrypt, 5 = DHB validator churn + TPU RS at that "
         "topology, 6 = the north-star metric (default, the driver's "
         "headline): fast-path epochs/sec, 64 nodes x 1024 instances, "
-        "device-resident",
+        "device-resident, 7 = verified decryption shares/s (TPU pairing "
+        "lanes vs native C++ per-share)",
     )
     p.add_argument(
         "--epochs",
@@ -399,6 +458,9 @@ def main(argv=None) -> int:
         return 0
     if args.config == 4:
         print(json.dumps(_bls_threshold_decrypt_config4(epochs_or(1024))))
+        return 0
+    if args.config == 7:
+        print(json.dumps(_verified_shares_config7(epochs_or(256))))
         return 0
 
     cpu_sps = _cpu_engine_throughput()

@@ -486,6 +486,62 @@ class TpuEngine(CpuEngine):
                 out[i] = th.Signature(g)
         return out  # type: ignore[return-value]
 
+    def verify_decryption_share_pairs(
+        self,
+        pk_shares: Sequence[th.PublicKeyShare],
+        shares: Sequence[th.DecryptionShare],
+        cts: Sequence[th.Ciphertext],
+    ) -> List[bool]:
+        """B INDEPENDENT share verifications e(S_i, H_i) == e(pk_i, W_i)
+        as one TPU pairing batch (ops/pairing_jax) — the
+        (instances x nodes) shape of the device-resident sim and the
+        verified-shares/s bench.  The same-ciphertext RLC collapse
+        (verify_decryption_shares_batch) does not apply across
+        instances with distinct ciphertexts; batched pairing lanes do."""
+        if not shares:
+            return []
+        from ..ops import pairing_jax
+
+        from . import bls12_381 as bls
+
+        hs = [
+            bls.hash_to_g2(th.g1_to_bytes(ct.u) + ct.v, b"HBTPU-TE")
+            for ct in cts
+        ]
+        return [
+            bool(v)
+            for v in pairing_jax.pairing_eq_batch(
+                [s.point for s in shares],
+                hs,
+                [pk.point for pk in pk_shares],
+                [ct.w for ct in cts],
+            )
+        ]
+
+    def verify_signature_share_pairs(
+        self,
+        pk_shares: Sequence[th.PublicKeyShare],
+        shares: Sequence[th.SignatureShare],
+        msgs: Sequence[bytes],
+    ) -> List[bool]:
+        """B independent e(G1, sigma_i) == e(pk_i, H(m_i)) checks as one
+        TPU pairing batch."""
+        if not shares:
+            return []
+        from ..ops import pairing_jax
+
+        from . import bls12_381 as bls
+
+        return [
+            bool(v)
+            for v in pairing_jax.pairing_eq_batch(
+                [bls.G1] * len(shares),
+                [s.point for s in shares],
+                [pk.point for pk in pk_shares],
+                [bls.hash_to_g2(m) for m in msgs],
+            )
+        ]
+
     @staticmethod
     def _quorum_prep(jobs_shares):
         """Shared combine scaffold: pick the lowest t+1 share ids per job,

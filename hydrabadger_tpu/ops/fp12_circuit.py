@@ -1,0 +1,340 @@
+"""Lane-bundled arithmetic circuits over Fp — the XLA-sized Fp12 tower.
+
+Why this exists: a BLS12-381 pairing is ~10^4 Fp multiplies.  Emitting
+them as individual limb-kernel calls (ops/bls_jax.fq_mul) produces an
+HLO graph XLA compiles superlinearly — tens of minutes on both CPU and
+TPU backends.  The fix is structural, and is also the TPU-native shape:
+evaluate whole tower operations as LAYERED CIRCUITS where
+
+  * every multiplication layer is ONE fq_mul call over a stacked lane
+    axis `[..., L, 32]` (one big Montgomery convolution einsum feeding
+    the MXU instead of L small ones), and
+  * everything between mul layers is an integer LINEAR MIX
+    `out[o] = sum_l M[o, l] * x[l]` evaluated as one einsum plus one
+    carry/normalize pass.
+
+The circuits are not hand-derived.  A tiny symbolic recorder runs the
+*reference formulas* (the same tower arithmetic the native C++ engine
+and pure-Python oracle use) over handles that track small-integer
+linear combinations; each `mul` schedules a product lane.  The recorded
+(S_left, S_right, T) matrices ARE the circuit — correct by
+construction, pinned by bit-equality tests against the oracle.
+
+Normalization: mixed values lie in (-Kp, Kp) with K <= 64.  They are
+offset by 64p, carried in a 35-limb working width, then canonicalised
+by a conditional-subtraction ladder of 64p/32p/16p/8p/4p/2p/p — all
+vector ops over the lane axis.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.bls12_381 import P
+from .bls_jax import (
+    LIMB_BITS,
+    LIMB_MASK,
+    N_LIMBS,
+    _carry,
+    _sub_limbs,
+    fq_mul,
+    int_to_limbs,
+)
+
+_WIDE = N_LIMBS + 3  # working width for values < 128p (< 2^388)
+_MIX_CAP = 64  # max absolute coefficient mass of any linear mix
+
+
+def _to_limbs_wide(n: int, width: int) -> np.ndarray:
+    return np.array(
+        [(n >> (LIMB_BITS * i)) & LIMB_MASK for i in range(width)],
+        dtype=np.int32,
+    )
+
+
+_OFFSET_64P = _to_limbs_wide(64 * P, _WIDE)
+_KP_WIDE = [_to_limbs_wide(k * P, _WIDE) for k in (64, 32, 16, 8, 4, 2, 1)]
+
+
+# -- scanless carry/borrow (circuit-local) ----------------------------------
+# The general limb kernels keep lax.scan carries (fastest to compile for
+# their small op counts); the circuit path replaces every carry with
+# bulk passes + Kogge-Stone lookahead so the big pairing scan bodies
+# have NO nested sequential loops — runtime depth is what matters when
+# one scan body holds hundreds of field operations.
+#
+# BACKEND-CONDITIONAL: the TPU compiler digests the KS graphs fine and
+# the runtime win is ~2x; XLA:CPU compiles them pathologically (>10
+# min), so on CPU the circuits fall back to the scan-based carries —
+# ~40 s compiles at the cost of sequential-depth runtime (tests use
+# tiny batches anyway).
+
+
+def _use_ks() -> bool:
+    import jax as _jax
+
+    return _jax.default_backend() == "tpu"
+
+
+def _shift_up(x: jax.Array, d: int):
+    pad_shape = x.shape[:-1] + (d,)
+    return jnp.concatenate(
+        [jnp.zeros(pad_shape, x.dtype), x[..., :-d]], axis=-1
+    )
+
+
+def _ks_resolve(g: jax.Array, p: jax.Array) -> jax.Array:
+    """G[i] = carry/borrow out of prefix [0..i]; 2^levels >= width."""
+    d = 1
+    n = g.shape[-1]
+    while d < n:
+        g = g | (p & _shift_up(g, d))
+        p = p & _shift_up(p, d)
+        d *= 2
+    return g
+
+
+def _carry_ks(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Same contract as bls_jax._carry (values < 2^31 - 2^19)."""
+    carry_out = jnp.zeros(x.shape[:-1], x.dtype)
+    for _ in range(3):
+        lo = x & LIMB_MASK
+        hi = x >> LIMB_BITS
+        carry_out = carry_out + hi[..., -1]
+        x = lo + _shift_up(hi, 1)
+    g = x >> LIMB_BITS != 0
+    p = (x & LIMB_MASK) == LIMB_MASK
+    G = _ks_resolve(g, p)
+    c_in = jnp.concatenate(
+        [jnp.zeros(x.shape[:-1] + (1,), bool), G[..., :-1]], axis=-1
+    ).astype(x.dtype)
+    carry_out = carry_out + G[..., -1].astype(x.dtype)
+    return (x + c_in) & LIMB_MASK, carry_out
+
+
+def _sub_ks(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Same contract as bls_jax._sub_limbs (canonical 12-bit inputs)."""
+    t = a - b
+    g = t < 0
+    p = t == 0
+    G = _ks_resolve(g, p)
+    c_in = jnp.concatenate(
+        [jnp.zeros(a.shape[:-1] + (1,), bool), G[..., :-1]], axis=-1
+    ).astype(a.dtype)
+    return (t - c_in) & LIMB_MASK, G[..., -1].astype(a.dtype)
+
+
+def _fq_mul_ks(a: jax.Array, b: jax.Array) -> jax.Array:
+    """bls_jax.fq_mul with scanless carries (identical math)."""
+    from .bls_jax import (
+        P_LIMBS,
+        PINV_LIMBS,
+        _IDX_FULL_C,
+        _IDX_LOW_C,
+        _MASK_FULL,
+        _MASK_LOW,
+        _conv,
+    )
+
+    c = _conv(a, b, _IDX_FULL_C, _MASK_FULL)
+    c, cc = _carry_ks(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)
+    m = _conv(cn[..., :N_LIMBS], jnp.asarray(PINV_LIMBS), _IDX_LOW_C, _MASK_LOW)
+    m, _ = _carry_ks(m)
+    mp = _conv(m, jnp.asarray(P_LIMBS), _IDX_FULL_C, _MASK_FULL)
+    t = cn + jnp.pad(mp, [(0, 0)] * (mp.ndim - 1) + [(0, 1)])
+    t, _ = _carry_ks(t)
+    r = t[..., N_LIMBS:]
+    d, borrow = _sub_ks(r, jnp.asarray(P_LIMBS))
+    return jnp.where((borrow == 0)[..., None], d, r)
+
+
+# ---------------------------------------------------------------------------
+# Symbolic circuit recorder
+# ---------------------------------------------------------------------------
+
+
+class Sym:
+    """A circuit value: a small-integer linear combination of wires."""
+
+    __slots__ = ("builder", "vec")
+
+    def __init__(self, builder: "CircuitBuilder", vec: Dict[int, int]):
+        self.builder = builder
+        self.vec = vec
+
+    def __add__(self, other: "Sym") -> "Sym":
+        v = dict(self.vec)
+        for k, c in other.vec.items():
+            nc = v.get(k, 0) + c
+            if nc:
+                v[k] = nc
+            else:
+                v.pop(k, None)
+        return Sym(self.builder, v)
+
+    def __sub__(self, other: "Sym") -> "Sym":
+        v = dict(self.vec)
+        for k, c in other.vec.items():
+            nc = v.get(k, 0) - c
+            if nc:
+                v[k] = nc
+            else:
+                v.pop(k, None)
+        return Sym(self.builder, v)
+
+    def __neg__(self) -> "Sym":
+        return Sym(self.builder, {k: -c for k, c in self.vec.items()})
+
+    def dbl(self) -> "Sym":
+        return Sym(self.builder, {k: 2 * c for k, c in self.vec.items()})
+
+    def __mul__(self, other: "Sym") -> "Sym":
+        return self.builder.mul(self, other)
+
+    def is_zero(self) -> bool:
+        return not self.vec
+
+
+@dataclass
+class _Layer:
+    lefts: List[Dict[int, int]] = field(default_factory=list)
+    rights: List[Dict[int, int]] = field(default_factory=list)
+    prod_wires: List[int] = field(default_factory=list)
+
+
+class CircuitBuilder:
+    """Records a layered circuit: wires are inputs, constants, and
+    product lanes; a product whose operands need layer k's outputs is
+    scheduled into layer k+1."""
+
+    def __init__(self, n_inputs: int):
+        self.n_inputs = n_inputs
+        self.n_wires = n_inputs
+        self.layers: List[_Layer] = []
+        self.wire_layer: Dict[int, int] = {i: -1 for i in range(n_inputs)}
+        self.constants: Dict[int, int] = {}
+
+    def input(self, i: int) -> Sym:
+        if not 0 <= i < self.n_inputs:
+            raise IndexError(i)
+        return Sym(self, {i: 1})
+
+    def const(self, value: int) -> Sym:
+        value %= P
+        for w, v in self.constants.items():
+            if v == value:
+                return Sym(self, {w: 1})
+        w = self.n_wires
+        self.n_wires += 1
+        self.wire_layer[w] = -1
+        self.constants[w] = value
+        return Sym(self, {w: 1})
+
+    def zero(self) -> Sym:
+        return Sym(self, {})
+
+    def mul(self, a: Sym, b: Sym) -> Sym:
+        if a.is_zero() or b.is_zero():
+            return self.zero()
+        ready = max(
+            max((self.wire_layer[w] for w in a.vec), default=-1),
+            max((self.wire_layer[w] for w in b.vec), default=-1),
+        )
+        lay = ready + 1
+        while len(self.layers) <= lay:
+            self.layers.append(_Layer())
+        w = self.n_wires
+        self.n_wires += 1
+        self.wire_layer[w] = lay
+        L = self.layers[lay]
+        L.lefts.append(dict(a.vec))
+        L.rights.append(dict(b.vec))
+        L.prod_wires.append(w)
+        return Sym(self, {w: 1})
+
+    def compile(self, outputs: Sequence[Sym]) -> "Circuit":
+        return Circuit(self, [dict(o.vec) for o in outputs])
+
+
+class Circuit:
+    """Executable form.  Wire columns are remapped to execution order
+    (inputs, constants, then products layer by layer) at build time, so
+    the runtime is just: mix, mix, lane-mul, append — per layer — and a
+    final output mix."""
+
+    def __init__(self, b: CircuitBuilder, out_vecs: List[Dict[int, int]]):
+        self.n_inputs = b.n_inputs
+        const_wires = sorted(b.constants)
+        self.const_vals = (
+            np.stack([int_to_limbs(b.constants[w]) for w in const_wires])
+            if const_wires
+            else np.zeros((0, N_LIMBS), np.int32)
+        )
+        exec_order = (
+            list(range(b.n_inputs))
+            + const_wires
+            + [w for lay in b.layers for w in lay.prod_wires]
+        )
+        col_of = {w: i for i, w in enumerate(exec_order)}
+
+        def remap(vecs: List[Dict[int, int]], width: int) -> np.ndarray:
+            M = np.zeros((len(vecs), width), np.int32)
+            for o, vec in enumerate(vecs):
+                for w, c in vec.items():
+                    M[o, col_of[w]] = c
+            return M
+
+        self.mats = []
+        avail = b.n_inputs + len(const_wires)
+        for lay in b.layers:
+            SL = remap(lay.lefts, avail)
+            SR = remap(lay.rights, avail)
+            self.mats.append((SL, SR))
+            avail += len(lay.prod_wires)
+        self.T = remap(out_vecs, avail)
+        for M in [m for pair in self.mats for m in pair] + [self.T]:
+            mass = np.abs(M).sum(axis=1).max(initial=0)
+            if mass > _MIX_CAP:
+                raise ValueError(f"mix mass {mass} exceeds ladder cap")
+        self.n_outputs = self.T.shape[0]
+        self.n_lanes = [SL.shape[0] for SL, _ in self.mats]
+
+    @staticmethod
+    def _mix(M: np.ndarray, have: jax.Array) -> jax.Array:
+        carry = _carry_ks if _use_ks() else _carry
+        sub = _sub_ks if _use_ks() else _sub_limbs
+        pos = np.where(M > 0, M, 0).astype(np.int32)
+        neg = np.where(M < 0, -M, 0).astype(np.int32)
+        t = jnp.einsum(
+            "ol,...lk->...ok", jnp.asarray(pos), have
+        ) - jnp.einsum("ol,...lk->...ok", jnp.asarray(neg), have)
+        # normalize: offset +64p, wide carry, cond-sub ladder
+        pad = [(0, 0)] * (t.ndim - 1) + [(0, _WIDE - N_LIMBS)]
+        t = jnp.pad(t, pad) + jnp.asarray(_OFFSET_64P)
+        t, _ = carry(t)
+        for kp in _KP_WIDE:
+            d, borrow = sub(t, jnp.asarray(kp))
+            t = jnp.where((borrow == 0)[..., None], d, t)
+        return t[..., :N_LIMBS]
+
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        """[..., n_inputs, 32] canonical Montgomery limbs ->
+        [..., n_outputs, 32]."""
+        batch = inputs.shape[:-2]
+        have = inputs
+        if self.const_vals.shape[0]:
+            consts = jnp.broadcast_to(
+                jnp.asarray(self.const_vals), batch + self.const_vals.shape
+            )
+            have = jnp.concatenate([have, consts], axis=-2)
+        for SL, SR in self.mats:
+            L = self._mix(SL, have)
+            R = self._mix(SR, have)
+            prod = _fq_mul_ks(L, R) if _use_ks() else fq_mul(L, R)
+            have = jnp.concatenate([have, prod], axis=-2)
+        return self._mix(self.T, have)

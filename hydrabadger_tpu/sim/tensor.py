@@ -165,3 +165,210 @@ def cpu_fast_path_epoch(proposals: np.ndarray, k: int, p: int) -> np.ndarray:
             shards = rs.reconstruct(slots, data_only=True)
             decoded[b, i] = np.stack(shards[:k])
     return decoded
+
+
+# ---------------------------------------------------------------------------
+# Full-crypto fast path: the BLS wall inside the epoch (VERDICT r1 item 3)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FullCryptoConfig:
+    """64-node x B-instance threshold-decryption plane.
+
+    The reference's epoch hot loop is RS coding AND threshold
+    decryption (state.rs:487): every node emits a decryption share
+    U*sk_i for every proposer's ciphertext, and any t+1 shares
+    Lagrange-combine to the plaintext point.  This sim runs that wall
+    device-resident: B*N*N share ladders and B*N point combines per
+    epoch, chained through a data-dependent ciphertext evolution so no
+    epoch can be elided."""
+
+    n_nodes: int = 64
+    instances: int = 256
+    seed: int = 0
+    share_chunks: int = 32  # sequential chunks bounding ladder-table memory
+
+    @property
+    def threshold(self) -> int:
+        return (self.n_nodes - 1) // 3
+
+
+class FullCryptoTensorSim:
+    """Device-resident threshold-decrypt epochs over [B, N] ciphertexts."""
+
+    def __init__(self, cfg: Optional[FullCryptoConfig] = None):
+        import random
+
+        from ..crypto import threshold as th
+        from ..ops import bls_jax as bj
+
+        self.cfg = cfg = cfg or FullCryptoConfig()
+        rng = random.Random(cfg.seed)
+        n, t = cfg.n_nodes, cfg.threshold
+        self._sk_set = th.SecretKeySet.random(t, rng)
+        self._sks = [
+            self._sk_set.secret_key_share(i).scalar for i in range(n)
+        ]
+        self._master = self._sk_set.secret_key().scalar
+        # fixed lowest-(t+1) quorum and its Lagrange coefficients
+        self._quorum = list(range(t + 1))
+        lam = th.lagrange_coeffs_at_zero([i + 1 for i in self._quorum])
+        # fold lambda_i into the share scalars for the combine ladder:
+        # combine = sum_i lambda_i * (U * sk_i) = sum_i U * (lambda_i sk_i)
+        # ... but the REAL combine must weight the already-generated
+        # share points, so the ladder runs on share points with lambda.
+        self._lam = lam
+        # per-epoch U evolution seed points: fresh random scalars r_bj
+        B = cfg.instances
+        from ..crypto import bls12_381 as bls
+
+        r0 = [rng.getrandbits(128) for _ in range(B * n)]
+        u0 = bj.points_to_limbs(
+            [bls.mul_sub(bls.G1, r) for r in r0]
+        ).reshape(B, n, 3, 32)
+        import jax as _jax
+
+        self._U = _jax.device_put(jnp.asarray(u0))
+        # device-resident window sets for the FIXED scalars
+        w1, w2 = bj.scalars_to_glv_windows(self._sks)
+        self._sk_w = (_jax.device_put(jnp.asarray(w1)),
+                      _jax.device_put(jnp.asarray(w2)))
+        lw1, lw2 = bj.scalars_to_glv_windows(self._lam)
+        self._lam_w = (_jax.device_put(jnp.asarray(lw1)),
+                       _jax.device_put(jnp.asarray(lw2)))
+        mw1, mw2 = bj.scalars_to_glv_windows([self._master])
+        self._m_w = (_jax.device_put(jnp.asarray(mw1)),
+                     _jax.device_put(jnp.asarray(mw2)))
+        self._epoch_fn = self._build_epoch()
+
+    def _build_epoch(self):
+        from functools import partial as _partial
+
+        import jax as _jax
+
+        from ..ops import bls_jax as bj
+
+        cfg = self.cfg
+        B, n, t = cfg.instances, cfg.n_nodes, cfg.threshold
+        q = t + 1
+        chunks = cfg.share_chunks
+
+        @_jax.jit
+        def epoch(U, sk_w1, sk_w2, lam_w1, lam_w2, m_w1, m_w2):
+            # 1. share generation: shares[b, j, i] = U[b, j] * sk_i
+            #    only the quorum's shares are materialised (q per ct):
+            #    lanes = B*n*q, chunked to bound the ladder table
+            Uq = jnp.broadcast_to(U[:, :, None], (B, n, q, 3, 32))
+            lanes = Uq.reshape(B * n * q, 3, 32)
+            w1 = jnp.broadcast_to(sk_w1[None, None, :q], (B, n, q, sk_w1.shape[-1]))
+            w2 = jnp.broadcast_to(sk_w2[None, None, :q], (B, n, q, sk_w2.shape[-1]))
+            w1 = w1.reshape(B * n * q, -1)
+            w2 = w2.reshape(B * n * q, -1)
+            share_lanes = _jax.lax.map(
+                lambda args: bj.jac_scalar_mul_glv(*args),
+                (
+                    lanes.reshape(chunks, -1, 3, 32),
+                    w1.reshape(chunks, -1, w1.shape[-1]),
+                    w2.reshape(chunks, -1, w2.shape[-1]),
+                ),
+            )
+            shares = share_lanes.reshape(B, n, q, 3, 32)
+            # 2. combine: weighted sum over the quorum with Lagrange
+            #    coefficients — q more ladders per ct, then q-1 adds
+            lw1 = jnp.broadcast_to(lam_w1[None, None], (B, n, q, lam_w1.shape[-1]))
+            lw2 = jnp.broadcast_to(lam_w2[None, None], (B, n, q, lam_w2.shape[-1]))
+            weighted = _jax.lax.map(
+                lambda args: bj.jac_scalar_mul_glv(*args),
+                (
+                    shares.reshape(chunks, -1, 3, 32),
+                    lw1.reshape(chunks, -1, lw1.shape[-1]),
+                    lw2.reshape(chunks, -1, lw2.shape[-1]),
+                ),
+            ).reshape(B, n, q, 3, 32)
+
+            def fold(i, acc):
+                return bj.jac_add(acc, weighted[:, :, i])
+
+            combined = _jax.lax.fori_loop(
+                1, q, fold, weighted[:, :, 0]
+            )  # [B, n, 3, 32]
+            # 3. on-device correctness: combined must equal U * master
+            mw1 = jnp.broadcast_to(m_w1[0][None, None], (B, n, m_w1.shape[-1]))
+            mw2 = jnp.broadcast_to(m_w2[0][None, None], (B, n, m_w2.shape[-1]))
+            direct = bj.jac_scalar_mul_glv(
+                U.reshape(B * n, 3, 32),
+                mw1.reshape(B * n, -1),
+                mw2.reshape(B * n, -1),
+            ).reshape(B, n, 3, 32)
+            ok = jnp.all(_jac_eq(combined, direct))
+            # 4. evolve ciphertexts (data-dependent; in-subgroup)
+            U_next = bj.jac_add(U, combined)
+            return U_next, ok
+
+        return epoch
+
+    def run(self, epochs: int) -> bool:
+        ok_all = True
+        for _ in range(epochs):
+            self._U, ok = self._epoch_fn(
+                self._U, *self._sk_w, *self._lam_w, *self._m_w
+            )
+            ok_all = ok_all and bool(ok)
+        return ok_all
+
+    def oracle_check(self) -> bool:
+        """Host CPU-oracle equality on a sample lane: evolve instance 0,
+        proposer 0 through one epoch with crypto/threshold.py and
+        compare against the device state."""
+        import random
+
+        from ..crypto import bls12_381 as bls
+        from ..crypto import threshold as th
+        from ..ops import bls_jax as bj
+
+        cfg = FullCryptoConfig(
+            n_nodes=self.cfg.n_nodes,
+            instances=1,
+            seed=self.cfg.seed,
+            share_chunks=1,
+        )
+        twin = FullCryptoTensorSim(cfg)
+        # one epoch on device (1 instance)
+        twin.run(1)
+        dev_pt = bj.limbs_to_points(
+            np.asarray(twin._U[0, 0])[None]
+        )[0]
+        # host oracle: replay the twin's own RNG stream (SecretKeySet
+        # first, then the U seeds) and its quorum/coefficients
+        rng = random.Random(cfg.seed)
+        th.SecretKeySet.random(cfg.threshold, rng)  # consume, same stream
+        r0 = rng.getrandbits(128)
+        u = bls.mul_sub(bls.G1, r0)
+        shares = {
+            i: th.DecryptionShare(bls.mul_sub(u, twin._sks[i]))
+            for i in twin._quorum
+        }
+        pts = {i + 1: s.point for i, s in shares.items()}
+        combined = th.interpolate_g_at_zero(pts)
+        expect_next = bls.add(u, combined)
+        return bls.eq(dev_pt, expect_next)
+
+
+def _jac_eq(a, b):
+    """Jacobian equality per lane: X1 Z2^2 == X2 Z1^2, Y1 Z2^3 == Y2 Z1^3."""
+    from ..ops import bls_jax as bj
+    from ..ops.bls_jax import fq_mul
+
+    z1, z2 = a[..., 2, :], b[..., 2, :]
+    z1s = fq_mul(z1, z1)
+    z2s = fq_mul(z2, z2)
+    x_ok = jnp.all(
+        fq_mul(a[..., 0, :], z2s) == fq_mul(b[..., 0, :], z1s), axis=-1
+    )
+    y_ok = jnp.all(
+        fq_mul(fq_mul(a[..., 1, :], z2s), z2)
+        == fq_mul(fq_mul(b[..., 1, :], z1s), z1),
+        axis=-1,
+    )
+    return x_ok & y_ok

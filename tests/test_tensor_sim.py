@@ -40,3 +40,16 @@ def test_corruption_is_detected():
         (np.asarray(decoded) == proposals).reshape(cfg.instances, -1).all(axis=1)
     )
     assert not ok2[0] and ok2[1]
+
+
+def test_full_crypto_tensor_sim_oracle():
+    """The full-crypto device epoch (share ladders + Lagrange combine +
+    ciphertext evolution) matches the host threshold-crypto oracle and
+    its on-device combined==U*master check holds every epoch."""
+    from hydrabadger_tpu.sim.tensor import FullCryptoConfig, FullCryptoTensorSim
+
+    sim = FullCryptoTensorSim(
+        FullCryptoConfig(n_nodes=4, instances=2, share_chunks=2)
+    )
+    assert sim.run(2)
+    assert sim.oracle_check()

@@ -449,9 +449,10 @@ def _full_crypto_epochs_config8(instances: int, epochs: int) -> dict:
     host_tier = "native" if native_bls.available() else "python"
     pt = bls.mul_sub(bls.G1, 12345)
     n_sample = 32
+    scalars = [rng.getrandbits(255) % bls.R for _ in range(n_sample)]
     t0 = time.perf_counter()
-    for i in range(n_sample):
-        bls.mul_sub(pt, 0x1234567 + i)
+    for k in scalars:
+        bls.mul_sub(pt, k)  # full-width scalars: ladder cost tracks top bit
     per_mul = (time.perf_counter() - t0) / n_sample
     q = cfg.threshold + 1
     muls_per_epoch = cfg.instances * cfg.n_nodes * (2 * q + 1)

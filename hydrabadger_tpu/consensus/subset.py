@@ -51,6 +51,7 @@ class Subset:
         }
         self.broadcast_results: Dict = {}
         self.ba_results: Dict = {}
+        self._voted_zero = False  # the N-f vote-0 sweep fires once
         self.decided = False
         self.result: Optional[dict] = None
 
@@ -81,7 +82,12 @@ class Subset:
         else:
             return step.fault(sender, f"subset: unknown inner {inner[0]!r}")
         step.extend(self._relabel(proposer, sub))
-        step.extend(self._progress())
+        # incremental progress: only the touched proposer's instances can
+        # have changed state; the full O(N) sweep runs only on the global
+        # transitions it flags (threshold reached / completion possible).
+        # At N=64 the full sweep per message made the logic tier O(N^3)
+        # with an O(N) constant — the dominant sim cost.
+        step.extend(self._progress_one(proposer))
         return step
 
     # -- internals ----------------------------------------------------------
@@ -95,8 +101,35 @@ class Subset:
         sub.output.clear()
         return sub
 
+    def _progress_one(self, proposer) -> Step:
+        """Incremental _progress: fold in state changes of ONE proposer's
+        broadcast/agreement, then run only the (rare, one-shot) global
+        transitions.  Equivalent to the full sweep because a message can
+        only change the instance it was routed to; the full sweep remains
+        for propose() and as the recursion target."""
+        step = Step()
+        bc = self.broadcasts.get(proposer)
+        if (
+            bc is not None
+            and proposer not in self.broadcast_results
+            and bc.terminated
+            and bc.payload is not None
+        ):
+            self.broadcast_results[proposer] = bc.payload
+            ba = self.agreements[proposer]
+            if ba.estimate is None and not ba.terminated:
+                step.extend(self._relabel(proposer, ba.propose(True)))
+        ba = self.agreements.get(proposer)
+        if ba is not None and proposer not in self.ba_results and ba.terminated:
+            self.ba_results[proposer] = ba.decision
+        step.extend(self._global_transitions())
+        # sub-steps above may have terminated the touched instances
+        if step.messages and not self.decided:
+            step.extend(self._progress_one(proposer))
+        return step
+
     def _progress(self) -> Step:
-        """Drive cross-instance rules; idempotent."""
+        """Drive cross-instance rules; idempotent (full sweep)."""
         step = Step()
         # capture broadcast payloads
         for nid, bc in self.broadcasts.items():
@@ -113,9 +146,22 @@ class Subset:
         for nid, ba in self.agreements.items():
             if nid not in self.ba_results and ba.terminated:
                 self.ba_results[nid] = ba.decision
+        step.extend(self._global_transitions())
+        # newly-produced sub-steps may have terminated more instances
+        if step.messages and not self.decided:
+            step.extend(self._progress())
+        return step
+
+    def _global_transitions(self) -> Step:
+        """One-shot network-wide rules, driven by cheap counters."""
+        step = Step()
         # N-f slots accepted: vote 0 everywhere else
         accepted = sum(1 for v in self.ba_results.values() if v)
-        if accepted >= self.netinfo.num_correct:
+        # getattr: pre-round-2 pickled sim checkpoints lack the flag
+        if accepted >= self.netinfo.num_correct and not getattr(
+            self, "_voted_zero", False
+        ):
+            self._voted_zero = True
             for nid, ba in self.agreements.items():
                 if ba.estimate is None and not ba.terminated:
                     step.extend(self._relabel(nid, ba.propose(False)))

@@ -243,8 +243,6 @@ class FullCryptoTensorSim:
         self._epoch_fn = self._build_epoch()
 
     def _build_epoch(self):
-        from functools import partial as _partial
-
         import jax as _jax
 
         from ..ops import bls_jax as bj
@@ -357,7 +355,6 @@ class FullCryptoTensorSim:
 
 def _jac_eq(a, b):
     """Jacobian equality per lane: X1 Z2^2 == X2 Z1^2, Y1 Z2^3 == Y2 Z1^3."""
-    from ..ops import bls_jax as bj
     from ..ops.bls_jax import fq_mul
 
     z1, z2 = a[..., 2, :], b[..., 2, :]

@@ -51,6 +51,7 @@ class Subset:
         }
         self.broadcast_results: Dict = {}
         self.ba_results: Dict = {}
+        self._accepted = 0  # count of True decisions (O(1) global check)
         self._voted_zero = False  # the N-f vote-0 sweep fires once
         self.decided = False
         self.result: Optional[dict] = None
@@ -105,8 +106,9 @@ class Subset:
         """Incremental _progress: fold in state changes of ONE proposer's
         broadcast/agreement, then run only the (rare, one-shot) global
         transitions.  Equivalent to the full sweep because a message can
-        only change the instance it was routed to; the full sweep remains
-        for propose() and as the recursion target."""
+        only change the instance it was routed to; self-generated
+        sub-steps re-fold incrementally, and the full sweep remains for
+        propose() and for _global_transitions' own cascades."""
         step = Step()
         bc = self.broadcasts.get(proposer)
         if (
@@ -121,12 +123,19 @@ class Subset:
                 step.extend(self._relabel(proposer, ba.propose(True)))
         ba = self.agreements.get(proposer)
         if ba is not None and proposer not in self.ba_results and ba.terminated:
-            self.ba_results[proposer] = ba.decision
+            self._record_decision(proposer, ba.decision)
         step.extend(self._global_transitions())
         # sub-steps above may have terminated the touched instances
         if step.messages and not self.decided:
             step.extend(self._progress_one(proposer))
         return step
+
+    def _record_decision(self, proposer, decision) -> None:
+        self.ba_results[proposer] = decision
+        if decision:
+            # O(1) accepted counter for the per-message global check
+            # (getattr: pre-round-2 pickled sim checkpoints lack it)
+            self._accepted = getattr(self, "_accepted", 0) + 1
 
     def _progress(self) -> Step:
         """Drive cross-instance rules; idempotent (full sweep)."""
@@ -145,7 +154,7 @@ class Subset:
         # capture ABA decisions
         for nid, ba in self.agreements.items():
             if nid not in self.ba_results and ba.terminated:
-                self.ba_results[nid] = ba.decision
+                self._record_decision(nid, ba.decision)
         step.extend(self._global_transitions())
         # newly-produced sub-steps may have terminated more instances
         if step.messages and not self.decided:
@@ -156,7 +165,10 @@ class Subset:
         """One-shot network-wide rules, driven by cheap counters."""
         step = Step()
         # N-f slots accepted: vote 0 everywhere else
-        accepted = sum(1 for v in self.ba_results.values() if v)
+        accepted = getattr(self, "_accepted", None)
+        if accepted is None:  # resumed pre-round-2 checkpoint: rebuild
+            accepted = sum(1 for v in self.ba_results.values() if v)
+            self._accepted = accepted
         # getattr: pre-round-2 pickled sim checkpoints lack the flag
         if accepted >= self.netinfo.num_correct and not getattr(
             self, "_voted_zero", False

@@ -45,6 +45,19 @@ from .threshold import (
 N = TypeVar("N", bound=Hashable)
 
 
+def g1_poly_eval(points, x: int):
+    """Evaluate a G1-point polynomial (coefficients low-to-high) at x:
+    Σ_j points[j] * x^j — the shared Horner-style accumulation used by
+    commitment folding and ack verification (and mirrored by
+    threshold.PublicKeySet.public_key_share)."""
+    acc = infinity(FQ)
+    xj = 1
+    for pt in points:
+        acc = add(acc, mul_sub(pt, xj))
+        xj = xj * x % R
+    return acc
+
+
 # ---------------------------------------------------------------------------
 # Bivariate polynomials and commitments
 # ---------------------------------------------------------------------------
@@ -118,6 +131,22 @@ class BivarCommitment:
             out.append(acc)
         return out
 
+    def column_commitment(self, y: int) -> List[tuple]:
+        """Commitment to the column poly f(·, y): col[j] = Σ_k P[j][k] y^k.
+
+        Folding the y variable once turns every later evaluate(x, y)
+        into t+1 scalar muls instead of (t+1)^2 — the DKG ack-verify
+        hot path does one evaluate per committed ack (O(N^2) of them
+        per era switch)."""
+        ys = [pow(y, k, R) for k in range(self.t + 1)]
+        out = []
+        for j in range(self.t + 1):
+            acc = infinity(FQ)
+            for k in range(self.t + 1):
+                acc = add(acc, mul_sub(self.points[j][k], ys[k]))
+            out.append(acc)
+        return out
+
     def to_bytes(self) -> bytes:
         return codec.encode(
             [[g1_to_bytes(p) for p in row] for row in self.points]
@@ -175,6 +204,8 @@ class _ProposalState:
     row: Optional[List[int]] = None  # our decrypted row f_s(i+1, y)
     values: Dict[int, int] = field(default_factory=dict)  # acker idx+1 -> val
     acks: set = field(default_factory=set)
+    # lazily-folded column commitment at y = our_idx+1 (ack verification)
+    our_column: Optional[List[tuple]] = None
 
     def is_complete(self, threshold: int) -> bool:
         """OBJECTIVE completion: counts structurally-valid acks, which are
@@ -320,8 +351,14 @@ class SyncKeyGen(Generic[N]):
             val = int.from_bytes(raw, "big") % R
         except (ValueError, TypeError):
             return AckOutcome(False, fault="undecryptable value")
-        # verify val == f_s(m+1, our_idx+1) against commitment
-        expected = state.commitment.evaluate(m + 1, self.our_idx + 1)
+        # verify val == f_s(m+1, our_idx+1) against the commitment; the
+        # y = our_idx+1 column is folded once per proposal (t+1 muls per
+        # ack instead of (t+1)^2 — N^2 acks make this the era-switch wall)
+        if state.our_column is None:
+            state.our_column = state.commitment.column_commitment(
+                self.our_idx + 1
+            )
+        expected = g1_poly_eval(state.our_column, m + 1)
         if not eq(mul_sub(G1, val), expected):
             return AckOutcome(False, fault="value/commitment mismatch")
         state.values[m + 1] = val

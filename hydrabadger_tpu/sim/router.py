@@ -42,7 +42,12 @@ class Router:
         self.adversary = adversary
         self.rng = random.Random(seed)
         self.shuffle = shuffle
-        self.queue: deque = deque()
+        # container by mode: a list supports the O(1) swap-pop random
+        # pick shuffle needs; a deque supports the O(1) popleft FIFO
+        # needs.  (deque.rotate for the random pick was O(queue) per
+        # delivery — with ~10^5 queued messages at N=64 it dominated
+        # the logic tier's wall time.)
+        self.queue = [] if shuffle else deque()
         self.outputs: Dict[Any, List[Any]] = {nid: [] for nid in self.node_ids}
         self.faults: List[Tuple[Any, Any]] = []
         self.delivered = 0
@@ -69,11 +74,15 @@ class Router:
     def deliver_one(self) -> bool:
         if not self.queue:
             return False
-        if self.shuffle and len(self.queue) > 1:
+        if self.shuffle:
+            # uniform random pick in O(1): swap with the tail and pop
             idx = self.rng.randrange(len(self.queue))
-            self.queue.rotate(-idx)
-            item = self.queue.popleft()
-            self.queue.rotate(idx)
+            last = self.queue.pop()
+            if idx == len(self.queue):
+                item = last
+            else:
+                item = self.queue[idx]
+                self.queue[idx] = last
         else:
             item = self.queue.popleft()
         sender, recipient, message = item

@@ -152,3 +152,18 @@ def test_ack_completion_counting_is_objective():
     vidx = sorted(ids).index(victim)
     sig = share_v.sign_share(b"objective")
     assert pk_set_v.verify_signature_share(vidx, sig, b"objective")
+
+
+def test_column_fold_matches_evaluate():
+    """The folded column commitment used by ack verification must equal
+    the direct bivariate evaluate at every (x, y) — evaluate() stays as
+    the oracle for the fold."""
+    from hydrabadger_tpu.crypto.dkg import BivarPoly, g1_poly_eval
+
+    rng = random.Random(77)
+    poly = BivarPoly.random(2, rng)
+    commit = poly.commitment()
+    for y in (1, 2, 5):
+        col = commit.column_commitment(y)
+        for x in (1, 3, 4):
+            assert commit.evaluate(x, y) == g1_poly_eval(col, x)

@@ -1,0 +1,252 @@
+"""Transposed-layout fq_mul: limbs in sublanes, batch in lanes ([32, B])."""
+import sys, time
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, "/root/repo")
+from hydrabadger_tpu.crypto.bls12_381 import P
+from hydrabadger_tpu.ops.bls_jax import (
+    LIMB_MASK, N_LIMBS, P_LIMBS, PINV_LIMBS, R_MONT,
+    ints_to_limbs_batch, limbs_to_ints_batch,
+)
+from experiments.conv_bench import (
+    T_PINV_LOW, T_P_FULL, _marginal, _sync, VARIANTS,
+)
+
+D = 2 * N_LIMBS
+
+
+def conv_T(a, b, n_out):
+    """[32, B] x [32, B] -> [n_out, B] schoolbook, unrolled row MACs."""
+    rows = []
+    for k in range(n_out):
+        acc = None
+        for i in range(max(0, k - N_LIMBS + 1), min(N_LIMBS - 1, k) + 1):
+            t = a[i] * b[k - i]
+            acc = t if acc is None else acc + t
+        rows.append(acc if acc is not None else jnp.zeros_like(a[0]))
+    return jnp.stack(rows)
+
+
+def carry_ks_T(x):
+    """[W, B] -> canonical limbs + carry row. KS along axis 0."""
+    carry_out = jnp.zeros_like(x[0])
+    for _ in range(3):
+        lo = x & LIMB_MASK
+        hi = x >> 12
+        carry_out = carry_out + hi[-1]
+        x = lo + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    g = x >> 12 != 0
+    p = (x & LIMB_MASK) == LIMB_MASK
+    d = 1
+    n = x.shape[0]
+    while d < n:
+        sg = jnp.concatenate([jnp.zeros_like(g[:d]), g[:-d]], axis=0)
+        sp = jnp.concatenate([jnp.zeros_like(p[:d]), p[:-d]], axis=0)
+        g = g | (p & sg)
+        p = p & sp
+        d *= 2
+    c_in = jnp.concatenate([jnp.zeros_like(g[:1]), g[:-1]], axis=0).astype(x.dtype)
+    carry_out = carry_out + g[-1].astype(x.dtype)
+    return (x + c_in) & LIMB_MASK, carry_out
+
+
+def sub_ks_T(a, b):
+    t = a - b
+    g = t < 0
+    p = t == 0
+    d = 1
+    n = a.shape[0]
+    while d < n:
+        sg = jnp.concatenate([jnp.zeros_like(g[:d]), g[:-d]], axis=0)
+        sp = jnp.concatenate([jnp.zeros_like(p[:d]), p[:-d]], axis=0)
+        g = g | (p & sg)
+        p = p & sp
+        d *= 2
+    c_in = jnp.concatenate([jnp.zeros_like(g[:1]), g[:-1]], axis=0).astype(a.dtype)
+    return (t - c_in) & LIMB_MASK, g[-1].astype(a.dtype)
+
+
+def limbs_to_digits_T(x):
+    """[32, B] -> [64, B] int8 (interleave lo/hi 6-bit)."""
+    lo = (x & 63).astype(jnp.int8)
+    hi = (x >> 6).astype(jnp.int8)
+    return jnp.stack([lo, hi], axis=1).reshape(D, *x.shape[1:])
+
+
+def digits_to_limbs_T(cd):
+    d = cd.shape[0]
+    if d % 2:
+        cd = jnp.concatenate([cd, jnp.zeros_like(cd[:1])], axis=0)
+    return cd[0::2] + (cd[1::2] << 6)
+
+
+PL_T = jnp.asarray(np.asarray(P_LIMBS))[:, None]
+
+
+def cond_sub_p_T(r):
+    d, borrow = sub_ks_T(r, PL_T)
+    return jnp.where(borrow == 0, d, r)
+
+
+def fq_mul_T(a, b):
+    """Transposed-layout Montgomery mul: [32, B] x [32, B] -> [32, B]."""
+    c = conv_T(a, b, 2 * N_LIMBS - 1)
+    c, cc = carry_ks_T(c)
+    cn = jnp.concatenate([c, cc[None]], axis=0)  # [64, B]
+    cd = limbs_to_digits_T(cn[:N_LIMBS])
+    md = jnp.einsum("ik,i...->k...", jnp.asarray(T_PINV_LOW), cd,
+                    preferred_element_type=jnp.int32)
+    m, _ = carry_ks_T(digits_to_limbs_T(md))
+    mdig = limbs_to_digits_T(m)
+    mpd = jnp.einsum("ik,i...->k...", jnp.asarray(T_P_FULL), mdig,
+                     preferred_element_type=jnp.int32)
+    t = cn + digits_to_limbs_T(mpd)
+    t, _ = carry_ks_T(t)
+    return cond_sub_p_T(t[N_LIMBS:])
+
+
+def fq_mul_T_vpu(a, b):
+    """All-VPU transposed variant (shared convs via conv_T too)."""
+    c = conv_T(a, b, 2 * N_LIMBS - 1)
+    c, cc = carry_ks_T(c)
+    cn = jnp.concatenate([c, cc[None]], axis=0)
+    pinv = jnp.asarray(np.asarray(PINV_LIMBS))[:, None] * jnp.ones_like(a[:1])
+    m_full = conv_T(cn[:N_LIMBS], pinv, N_LIMBS)  # low conv only
+    m, _ = carry_ks_T(m_full)
+    pl_ = PL_T * jnp.ones_like(a[:1])
+    mp = conv_T(m, pl_, 2 * N_LIMBS - 1)
+    mp64 = jnp.concatenate([mp, jnp.zeros_like(mp[:1])], axis=0)
+    t = cn + mp64
+    t, _ = carry_ks_T(t)
+    return cond_sub_p_T(t[N_LIMBS:])
+
+
+def validate(fn):
+    rng = np.random.default_rng(3)
+    a_int = [int(x) * 7919 % P for x in rng.integers(0, 2**63, 8)]
+    b_int = [(int(x) * 104729 + 17) % P for x in rng.integers(0, 2**63, 8)]
+    a = jnp.asarray(ints_to_limbs_batch(a_int)).T  # [32, 8]
+    b = jnp.asarray(ints_to_limbs_batch(b_int)).T
+    got = limbs_to_ints_batch(np.asarray(jax.device_get(fn(a, b))).T)
+    rinv = pow(R_MONT, -1, P)
+    want = [x * y * rinv % P for x, y in zip(a_int, b_int)]
+    return got == want
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    R = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    print(f"backend={jax.default_backend()}")
+    rng = np.random.default_rng(0)
+    a_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 31337]
+    b_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 271828]
+    aT = jax.device_put(jnp.asarray(ints_to_limbs_batch(a_int)).T)
+    bT = jax.device_put(jnp.asarray(ints_to_limbs_batch(b_int)).T)
+    for name, fn in [("T_mxu8", fq_mul_T), ("T_vpu", fq_mul_T_vpu)]:
+        ok = validate(fn)
+        print(f"{name:12s} exact={'OK' if ok else 'FAIL'}")
+        if not ok:
+            continue
+        per_step = _marginal(fn, aT, bT, R // 8, R)
+        print(f"{name:12s} B={B}  {per_step/B*1e9:8.2f} ns/fq_mul "
+              f"({B/per_step/1e6:7.2f} M muls/s)")
+
+
+def main2():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    R = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    if "components" in sys.argv:
+        print(f"backend={jax.default_backend()}")
+        components(B, R)
+        return
+    if "sqr" in sys.argv:
+        rng = np.random.default_rng(0)
+        a_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 31337]
+        aT = jax.device_put(jnp.asarray(ints_to_limbs_batch(a_int)).T)
+        rinv = pow(R_MONT, -1, P)
+        got = limbs_to_ints_batch(np.asarray(jax.device_get(fq_sqr_T(aT[:, :8]))).T)
+        want = [x * x * rinv % P for x in a_int[:8]]
+        print("sqr exact=", got == want)
+        per_step = _marginal(fq_sqr_T, aT, aT, R // 8, R)
+        print(f"fq_sqr_T B={B}  {per_step/B*1e9:8.2f} ns/sqr")
+        return
+    main()
+
+
+def fq_sqr_T(a, _b_ignored=None):
+    """Squaring: c[k] = 2*sum_{i<j} a_i a_j + a_{k/2}^2 — ~half the MACs."""
+    rows = []
+    for k in range(2 * N_LIMBS - 1):
+        acc = None
+        lo = max(0, k - N_LIMBS + 1)
+        hi = min(N_LIMBS - 1, k)
+        i = lo
+        while i < k - i:
+            t = a[i] * a[k - i]
+            acc = t if acc is None else acc + t
+            i += 1
+        if acc is not None:
+            acc = acc + acc
+        if k % 2 == 0 and lo <= k // 2 <= hi:
+            sq = a[k // 2] * a[k // 2]
+            acc = sq if acc is None else acc + sq
+        rows.append(acc if acc is not None else jnp.zeros_like(a[0]))
+    c = jnp.stack(rows)
+    c, cc = carry_ks_T(c)
+    cn = jnp.concatenate([c, cc[None]], axis=0)
+    cd = limbs_to_digits_T(cn[:N_LIMBS])
+    md = jnp.einsum("ik,i...->k...", jnp.asarray(T_PINV_LOW), cd,
+                    preferred_element_type=jnp.int32)
+    m, _ = carry_ks_T(digits_to_limbs_T(md))
+    mdig = limbs_to_digits_T(m)
+    mpd = jnp.einsum("ik,i...->k...", jnp.asarray(T_P_FULL), mdig,
+                     preferred_element_type=jnp.int32)
+    t = cn + digits_to_limbs_T(mpd)
+    t, _ = carry_ks_T(t)
+    return cond_sub_p_T(t[N_LIMBS:])
+
+
+def components(B, R):
+    rng = np.random.default_rng(0)
+    a_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 31337]
+    b_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 271828]
+    aT = jax.device_put(jnp.asarray(ints_to_limbs_batch(a_int)).T)
+    bT = jax.device_put(jnp.asarray(ints_to_limbs_batch(b_int)).T)
+
+    def p_noop(x, b):
+        return (x * 3 + b) & LIMB_MASK
+
+    def p_conv(x, b):
+        c = conv_T(x, b, 2 * N_LIMBS - 1)
+        return (c[:N_LIMBS] & LIMB_MASK) ^ x
+
+    def p_carry(x, b):
+        y, _ = carry_ks_T(x * 3 + b)
+        return y
+
+    def p_toep(x, b):
+        cd = limbs_to_digits_T(x)
+        md = jnp.einsum("ik,i...->k...", jnp.asarray(T_PINV_LOW), cd,
+                        preferred_element_type=jnp.int32)
+        return (digits_to_limbs_T(md) & LIMB_MASK) ^ b
+
+    def p_toep127(x, b):
+        cd = limbs_to_digits_T(x)
+        md = jnp.einsum("ik,i...->k...", jnp.asarray(T_P_FULL), cd,
+                        preferred_element_type=jnp.int32)
+        return (digits_to_limbs_T(md)[:N_LIMBS] & LIMB_MASK) ^ b
+
+    def p_sub(x, b):
+        d, _ = sub_ks_T(x, b)
+        return d
+
+    for name, fn in [
+        ("noop", p_noop), ("conv_T(63)", p_conv), ("carry_ks_T", p_carry),
+        ("toeplitz64_T", p_toep), ("toeplitz127_T", p_toep127),
+        ("sub_ks_T", p_sub),
+    ]:
+        per_step = _marginal(fn, aT, bT, R // 8, R)
+        print(f"  {name:16s} {per_step/B*1e9:8.2f} ns/op")
+
+
+if __name__ == "__main__":
+    main2()

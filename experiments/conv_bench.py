@@ -1,0 +1,390 @@
+"""Microbenchmark: Montgomery fq_mul strategies on the real TPU.
+
+Round-3 experiment behind VERDICT item 1 (int8 MXU decomposition).
+Variants measured as a scan-chained kernel (R muls per dispatch):
+
+  A. current: gather+einsum int32 convs, lax.scan carries
+  B. current convs, Kogge-Stone carries
+  C. shifted-MAC conv (no gather) int32, KS carries
+  D. per-lane conv int32 shifted-MAC + SHARED Toeplitz int8 MXU for the
+     PINV/P convs, KS carries
+  E. all-digit int8 gather+einsum convs, KS carries
+  F. D but with bf16 MXU Toeplitz (exactness via f32 accum)
+
+Each variant is validated bit-exactly against the pure-Python oracle
+before timing.  Run:  python experiments/conv_bench.py [B] [R]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from hydrabadger_tpu.crypto.bls12_381 import P
+from hydrabadger_tpu.ops.bls_jax import (
+    LIMB_BITS,
+    LIMB_MASK,
+    N_LIMBS,
+    P_LIMBS,
+    PINV_LIMBS,
+    R_MONT,
+    _IDX_FULL_C,
+    _IDX_LOW_C,
+    _MASK_FULL,
+    _MASK_LOW,
+    _carry,
+    _conv,
+    _cond_sub_p,
+    _sub_limbs,
+    ints_to_limbs_batch,
+    limbs_to_ints_batch,
+)
+from hydrabadger_tpu.ops.fp12_circuit import _carry_ks, _sub_ks
+
+
+# --- digit helpers (6-bit, radix-64, 64 digits) ---------------------------
+
+def limbs_to_digits(x):
+    """[..., 32] 12-bit limbs -> [..., 64] 6-bit digits, int8."""
+    lo = (x & 63).astype(jnp.int8)
+    hi = (x >> 6).astype(jnp.int8)
+    return jnp.stack([lo, hi], axis=-1).reshape(*x.shape[:-1], 2 * N_LIMBS)
+
+
+def digits_to_limbs(cd):
+    """[..., D] digit-conv values (int32) -> [..., ceil(D/2)] limb values."""
+    d = cd.shape[-1]
+    if d % 2:
+        cd = jnp.pad(cd, [(0, 0)] * (cd.ndim - 1) + [(0, 1)])
+    ev = cd[..., 0::2]
+    od = cd[..., 1::2]
+    return ev + (od << 6)
+
+
+def _toeplitz_digits(const_limbs: np.ndarray, n_out: int) -> np.ndarray:
+    """Shared conv matrix M[i, k] = digit[k - i], [64, n_out] int8."""
+    digs = np.zeros(2 * N_LIMBS, np.int64)
+    digs[0::2] = const_limbs & 63
+    digs[1::2] = const_limbs >> 6
+    i = np.arange(2 * N_LIMBS)[:, None]
+    k = np.arange(n_out)[None, :]
+    idx = k - i
+    ok = (idx >= 0) & (idx < 2 * N_LIMBS)
+    return np.where(ok, digs[np.clip(idx, 0, 2 * N_LIMBS - 1)], 0).astype(
+        np.int8
+    )
+
+
+T_PINV_LOW = _toeplitz_digits(PINV_LIMBS, 2 * N_LIMBS)          # [64, 64]
+T_P_FULL = _toeplitz_digits(P_LIMBS, 4 * N_LIMBS - 1)           # [64, 127]
+
+
+def _conv_shift(a, b, n_out):
+    """Gather-free conv: sum of shifted broadcast-MACs (int32 VPU)."""
+    parts = []
+    for i in range(N_LIMBS):
+        term = a[..., i : i + 1] * b  # [..., 32]
+        pad = [(0, 0)] * (term.ndim - 1) + [(i, n_out - i - N_LIMBS)]
+        parts.append(jnp.pad(term, pad))
+    out = parts[0]
+    for t in parts[1:]:
+        out = out + t
+    return out
+
+
+def _conv_shift_low(a, b):
+    """Low 32 limbs of the product (mod R)."""
+    out = a[..., 0:1] * b
+    for i in range(1, N_LIMBS):
+        term = a[..., i : i + 1] * b[..., : N_LIMBS - i]
+        out = out + jnp.pad(term, [(0, 0)] * (term.ndim - 1) + [(i, 0)])
+    return out
+
+
+# --- fq_mul variants -------------------------------------------------------
+
+def fq_mul_A(a, b):  # current production path
+    c = _conv(a, b, _IDX_FULL_C, _MASK_FULL)
+    c, cc = _carry(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)
+    m = _conv(cn[..., :N_LIMBS], jnp.asarray(PINV_LIMBS), _IDX_LOW_C, _MASK_LOW)
+    m, _ = _carry(m)
+    mp = _conv(m, jnp.asarray(P_LIMBS), _IDX_FULL_C, _MASK_FULL)
+    t = cn + jnp.pad(mp, [(0, 0)] * (mp.ndim - 1) + [(0, 1)])
+    t, _ = _carry(t)
+    return _cond_sub_p(t[..., N_LIMBS:])
+
+
+def _cond_sub_p_ks(r):
+    d, borrow = _sub_ks(r, jnp.asarray(P_LIMBS))
+    return jnp.where((borrow == 0)[..., None], d, r)
+
+
+def fq_mul_B(a, b):  # current convs + KS carries
+    c = _conv(a, b, _IDX_FULL_C, _MASK_FULL)
+    c, cc = _carry_ks(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)
+    m = _conv(cn[..., :N_LIMBS], jnp.asarray(PINV_LIMBS), _IDX_LOW_C, _MASK_LOW)
+    m, _ = _carry_ks(m)
+    mp = _conv(m, jnp.asarray(P_LIMBS), _IDX_FULL_C, _MASK_FULL)
+    t = cn + jnp.pad(mp, [(0, 0)] * (mp.ndim - 1) + [(0, 1)])
+    t, _ = _carry_ks(t)
+    return _cond_sub_p_ks(t[..., N_LIMBS:])
+
+
+def fq_mul_C(a, b):  # shifted-MAC convs, KS carries
+    c = _conv_shift(a, b, 2 * N_LIMBS - 1)
+    c, cc = _carry_ks(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)
+    m = _conv_shift_low(cn[..., :N_LIMBS], jnp.asarray(PINV_LIMBS))
+    m, _ = _carry_ks(m)
+    mp = _conv_shift(m, jnp.asarray(P_LIMBS), 2 * N_LIMBS - 1)
+    t = cn + jnp.pad(mp, [(0, 0)] * (mp.ndim - 1) + [(0, 1)])
+    t, _ = _carry_ks(t)
+    return _cond_sub_p_ks(t[..., N_LIMBS:])
+
+
+def fq_mul_D(a, b):  # per-lane shifted-MAC + shared int8 MXU Toeplitz
+    c = _conv_shift(a, b, 2 * N_LIMBS - 1)
+    c, cc = _carry_ks(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)
+    cd = limbs_to_digits(cn[..., :N_LIMBS])
+    md = jnp.einsum(
+        "...i,ik->...k",
+        cd,
+        jnp.asarray(T_PINV_LOW),
+        preferred_element_type=jnp.int32,
+    )
+    m, _ = _carry_ks(digits_to_limbs(md))
+    mdig = limbs_to_digits(m)
+    mpd = jnp.einsum(
+        "...i,ik->...k",
+        mdig,
+        jnp.asarray(T_P_FULL),
+        preferred_element_type=jnp.int32,
+    )
+    mp64 = digits_to_limbs(mpd)  # [..., 64]
+    t = cn + mp64
+    t, _ = _carry_ks(t)
+    return _cond_sub_p_ks(t[..., N_LIMBS:])
+
+
+_IDX_FULL_D = np.arange(4 * N_LIMBS - 1)[:, None] - np.arange(2 * N_LIMBS)[None, :]
+_MASK_FULL_D = ((_IDX_FULL_D >= 0) & (_IDX_FULL_D < 2 * N_LIMBS)).astype(np.int8)
+_IDX_FULL_DC = np.clip(_IDX_FULL_D, 0, 2 * N_LIMBS - 1)
+
+
+def fq_mul_E(a, b):  # all-digit int8 gather+einsum
+    ad = limbs_to_digits(a)
+    bd = limbs_to_digits(b)
+    b_exp = jnp.take(bd, jnp.asarray(_IDX_FULL_DC), axis=-1) * jnp.asarray(
+        _MASK_FULL_D
+    )
+    cd = jnp.einsum(
+        "...i,...ki->...k", ad, b_exp, preferred_element_type=jnp.int32
+    )
+    c64 = digits_to_limbs(cd)  # [..., 64]
+    cn, cc = _carry_ks(c64)
+    # carry-out folds into limb 63 slot; product < 2^766 so limb63+cc < 2^12?
+    cn = cn.at[..., -1].add(cc << 0) if False else cn  # cc==0 in range
+    cd2 = limbs_to_digits(cn[..., :N_LIMBS])
+    md = jnp.einsum(
+        "...i,ik->...k",
+        cd2,
+        jnp.asarray(T_PINV_LOW),
+        preferred_element_type=jnp.int32,
+    )
+    m, _ = _carry_ks(digits_to_limbs(md))
+    mdig = limbs_to_digits(m)
+    mpd = jnp.einsum(
+        "...i,ik->...k",
+        mdig,
+        jnp.asarray(T_P_FULL),
+        preferred_element_type=jnp.int32,
+    )
+    t = cn + digits_to_limbs(mpd)
+    t, _ = _carry_ks(t)
+    return _cond_sub_p_ks(t[..., N_LIMBS:])
+
+
+def fq_mul_F(a, b):  # D but bf16 MXU Toeplitz (exact: values < 2^24 in f32 accum)
+    c = _conv_shift(a, b, 2 * N_LIMBS - 1)
+    c, cc = _carry_ks(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)
+    cd = limbs_to_digits(cn[..., :N_LIMBS]).astype(jnp.bfloat16)
+    md = jnp.einsum(
+        "...i,ik->...k",
+        cd,
+        jnp.asarray(T_PINV_LOW, dtype=jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    m, _ = _carry_ks(digits_to_limbs(md))
+    mdig = limbs_to_digits(m).astype(jnp.bfloat16)
+    mpd = jnp.einsum(
+        "...i,ik->...k",
+        mdig,
+        jnp.asarray(T_P_FULL, dtype=jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    t = cn + digits_to_limbs(mpd)
+    t, _ = _carry_ks(t)
+    return _cond_sub_p_ks(t[..., N_LIMBS:])
+
+
+VARIANTS = {
+    "A_current": fq_mul_A,
+    "B_ks": fq_mul_B,
+    "C_shift_ks": fq_mul_C,
+    "D_shift_mxu8": fq_mul_D,
+    "E_digit8": fq_mul_E,
+    "F_shift_mxubf16": fq_mul_F,
+}
+
+
+def _sync(x):
+    jax.device_get(x.reshape(-1)[:1])
+
+
+def validate(fn, rng) -> bool:
+    xs = [rng.integers(0, 2**63) for _ in range(8)]
+    a_int = [int(x) * 7919 % P for x in xs]
+    b_int = [(int(x) * 104729 + 17) % P for x in xs]
+    a = jnp.asarray(ints_to_limbs_batch(a_int))
+    b = jnp.asarray(ints_to_limbs_batch(b_int))
+    got = limbs_to_ints_batch(np.asarray(jax.device_get(fn(a, b))))
+    rinv = pow(R_MONT, -1, P)
+    want = [x * y * rinv % P for x, y in zip(a_int, b_int)]
+    return got == want
+
+
+def _marginal(stepfn, a, b, r1, r2):
+    """Differential timing: cancels the ~100 ms axon dispatch latency."""
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("r",))
+    def chain(a, b, r):
+        def body(x, _):
+            return stepfn(x, b), None
+
+        out, _ = jax.lax.scan(body, a, None, length=r)
+        return out
+
+    for r in (r1, r2):
+        _sync(chain(a, b, r))  # compile both
+    ts = []
+    for r in (r1, r2, r1, r2):
+        t0 = time.perf_counter()
+        _sync(chain(a, b, r))
+        ts.append(time.perf_counter() - t0)
+    t1 = min(ts[0], ts[2])
+    t2 = min(ts[1], ts[3])
+    return (t2 - t1) / (r2 - r1)
+
+
+def bench(name, fn, B, R):
+    rng = np.random.default_rng(0)
+    a_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 31337]
+    b_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 271828]
+    a = jax.device_put(jnp.asarray(ints_to_limbs_batch(a_int)))
+    b = jax.device_put(jnp.asarray(ints_to_limbs_batch(b_int)))
+    per_step = _marginal(fn, a, b, R // 8, R)
+    ns = per_step / B * 1e9
+    print(
+        f"{name:18s} B={B}  {ns:8.2f} ns/fq_mul "
+        f"({B/per_step/1e6:7.2f} M muls/s, {per_step*1e6:7.1f} us/step)"
+    )
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    R = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    only = sys.argv[3].split(",") if len(sys.argv) > 3 else None
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    rng = np.random.default_rng(42)
+    for name, fn in VARIANTS.items():
+        if only and not any(name.startswith(o) for o in only):
+            continue
+        ok = validate(fn, rng)
+        print(f"{name:18s} exact={'OK' if ok else 'FAIL'}")
+        if not ok:
+            continue
+        bench(name, fn, B, R)
+
+
+
+
+
+# --- component-level timings ----------------------------------------------
+
+def bench_components(B, R):
+    rng = np.random.default_rng(1)
+    a_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 31337]
+    b_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 271828]
+    a = jax.device_put(jnp.asarray(ints_to_limbs_batch(a_int)))
+    b = jax.device_put(jnp.asarray(ints_to_limbs_batch(b_int)))
+
+    def chain_of(stepfn):
+        @jax.jit
+        def chain(a, b):
+            def body(x, _):
+                return stepfn(x, b), None
+            out, _ = jax.lax.scan(body, a, None, length=R)
+            return out
+        return chain
+
+    def piece_conv_shift(x, b):
+        c = _conv_shift(x, b, 2 * N_LIMBS - 1)
+        return (c[..., :N_LIMBS] & LIMB_MASK) ^ x  # keep int range, dep chain
+
+    def piece_conv_einsum(x, b):
+        c = _conv(x, b, _IDX_FULL_C, _MASK_FULL)
+        return (c[..., :N_LIMBS] & LIMB_MASK) ^ x
+
+    def piece_carry_ks(x, b):
+        y, _ = _carry_ks(x * 3 + b)
+        return y
+
+    def piece_carry_scan(x, b):
+        y, _ = _carry(x * 3 + b)
+        return y
+
+    def piece_toeplitz8(x, b):
+        cd = limbs_to_digits(x)
+        md = jnp.einsum("...i,ik->...k", cd, jnp.asarray(T_PINV_LOW),
+                        preferred_element_type=jnp.int32)
+        return (digits_to_limbs(md) & LIMB_MASK) ^ b
+
+    def piece_sub_ks(x, b):
+        d, _ = _sub_ks(x, b)
+        return d
+
+    def piece_noop(x, b):
+        return (x * 3 + b) & LIMB_MASK
+
+    for name, fn in [
+        ("noop_pointwise", piece_noop),
+        ("conv_shift(63)", piece_conv_shift),
+        ("conv_einsum(63)", piece_conv_einsum),
+        ("carry_ks(32)", piece_carry_ks),
+        ("carry_scan(32)", piece_carry_scan),
+        ("toeplitz_mxu8(64)", piece_toeplitz8),
+        ("sub_ks(32)", piece_sub_ks),
+    ]:
+        per_step = _marginal(fn, a, b, R // 8, R)
+        print(f"  {name:20s} {per_step/B*1e9:8.2f} ns/op ({per_step*1e6:8.1f} us/step)")
+
+
+
+
+
+if __name__ == "__main__":
+    if "components" in sys.argv:
+        print(f"backend={jax.default_backend()}")
+        bench_components(int(sys.argv[1]), int(sys.argv[2]))
+    else:
+        main()

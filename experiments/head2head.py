@@ -1,0 +1,37 @@
+import sys, time
+from functools import partial
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, "/root/repo")
+from hydrabadger_tpu.crypto.bls12_381 import P
+from hydrabadger_tpu.ops.bls_jax import ints_to_limbs_batch
+from experiments.conv_bench import fq_mul_A, fq_mul_D, _sync
+from experiments.conv_T import fq_mul_T
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+R1, R2 = 64, 512
+rng = np.random.default_rng(0)
+a_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 31337]
+b_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 271828]
+a = jax.device_put(jnp.asarray(ints_to_limbs_batch(a_int)))
+b = jax.device_put(jnp.asarray(ints_to_limbs_batch(b_int)))
+aT, bT = jax.device_put(a.T), jax.device_put(b.T)
+
+def measure(name, fn, x, y):
+    @partial(jax.jit, static_argnames=("r",))
+    def chain(x, y, r):
+        def body(c, _):
+            return fn(c, y), None
+        out, _ = jax.lax.scan(body, x, None, length=r)
+        return out
+    _sync(chain(x, y, R1)); _sync(chain(x, y, R2))
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter(); _sync(chain(x, y, R1)); t1 = time.perf_counter()
+        t0b = time.perf_counter(); _sync(chain(x, y, R2)); t1b = time.perf_counter()
+        d = ((t1b - t0b) - (t1 - t0)) / (R2 - R1)
+        best = d if best is None else min(best, d)
+    print(f"{name:10s} B={B}  {best/B*1e9:7.2f} ns/fq_mul ({B/best/1e6:7.1f} M/s)")
+
+measure("A_current", fq_mul_A, a, b)
+measure("D_mxu8", fq_mul_D, a, b)
+measure("T_mxu8", fq_mul_T, aT, bT)

@@ -1,0 +1,49 @@
+import sys, time
+import jax, jax.numpy as jnp, numpy as np
+
+def _sync(x): jax.device_get(x.reshape(-1)[:1])
+
+print("backend", jax.default_backend())
+
+# 1. raw bf16 matmul FLOPS
+for n in (2048, 4096):
+    a = jax.device_put(jnp.ones((n, n), jnp.bfloat16))
+    f = jax.jit(lambda a: a @ a)
+    _sync(f(a)); t0=time.perf_counter(); _sync(f(a)); dt=time.perf_counter()-t0
+    print(f"matmul {n}: {2*n**3/dt/1e12:.1f} TFLOPS ({dt*1e3:.2f} ms)")
+
+# 2. int8 matmul TOPS
+n = 4096
+a8 = jax.device_put(jnp.ones((n, n), jnp.int8))
+f8 = jax.jit(lambda a: jax.lax.dot(a, a, preferred_element_type=jnp.int32))
+_sync(f8(a8)); t0=time.perf_counter(); _sync(f8(a8)); dt=time.perf_counter()-t0
+print(f"int8 matmul {n}: {2*n**3/dt/1e12:.1f} TOPS ({dt*1e3:.2f} ms)")
+
+# 3. pointwise chain: scaling in R (fixed B)
+B = 131072
+x = jax.device_put(jnp.ones((B, 32), jnp.int32))
+for R in (8, 32, 128):
+    @jax.jit
+    def chain(x, R=R):
+        def body(c, _): return (c * 3 + 1) & 4095, None
+        out, _ = jax.lax.scan(body, x, None, length=R)
+        return out
+    _sync(chain(x)); t0=time.perf_counter(); _sync(chain(x)); dt=time.perf_counter()-t0
+    print(f"noop chain B={B} R={R}: {dt*1e3:8.2f} ms  ({dt/R*1e6:8.1f} us/step)")
+
+# 4. same total work, unrolled instead of scan
+R = 32
+@jax.jit
+def unrolled(x):
+    for _ in range(R):
+        x = (x * 3 + 1) & 4095
+    return x
+_sync(unrolled(x)); t0=time.perf_counter(); _sync(unrolled(x)); dt=time.perf_counter()-t0
+print(f"noop unrolled R={R}: {dt*1e3:8.2f} ms ({dt/R*1e6:8.1f} us/step)")
+
+# 5. fori_loop variant
+@jax.jit
+def floop(x):
+    return jax.lax.fori_loop(0, R, lambda i, c: (c * 3 + 1) & 4095, x)
+_sync(floop(x)); t0=time.perf_counter(); _sync(floop(x)); dt=time.perf_counter()-t0
+print(f"noop fori R={R}: {dt*1e3:8.2f} ms ({dt/R*1e6:8.1f} us/step)")

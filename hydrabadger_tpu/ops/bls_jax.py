@@ -38,6 +38,7 @@ entry points here.
 """
 from __future__ import annotations
 
+import os as _os
 from typing import Sequence
 
 import jax
@@ -46,6 +47,13 @@ import numpy as np
 
 from ..crypto import bls12_381 as bls
 from ..crypto.bls12_381 import FQ, P
+
+# fq_mul path selection: "mxu" (shifted per-lane conv + int8 Toeplitz
+# matmuls + KS carries — the TPU production path), "einsum" (round-2
+# gather+einsum + scan carries — the XLA:CPU-friendly oracle twin), or
+# backend default (TPU -> mxu, CPU -> einsum).  Overridable for tests
+# via HYDRABADGER_FQ_PATH.
+_FQ_PATH_ENV = _os.environ.get("HYDRABADGER_FQ_PATH", "")
 
 # ---------------------------------------------------------------------------
 # Limb layout and Montgomery constants (host numpy; become jit constants)
@@ -99,6 +107,51 @@ _IDX_LOW = np.arange(N_LIMBS)[:, None] - np.arange(N_LIMBS)[None, :]
 _MASK_LOW = (_IDX_LOW >= 0).astype(np.int32)
 _IDX_LOW_C = np.clip(_IDX_LOW, 0, N_LIMBS - 1)
 
+# -- 6-bit digit decomposition (round 3: the int8 MXU path) -----------------
+#
+# A 12-bit limb splits into two radix-64 digits (<= 63, signed-int8-safe).
+# The two Montgomery-internal convolutions multiply by CONSTANTS (-p^-1
+# mod R, then p), so each lowers to ONE shared Toeplitz matmul
+# `[..., 64] @ [64, K]` with int8 operands and int32 accumulation — the
+# shape the MXU wants (batch streams through resident weights), unlike
+# the per-lane a*b convolution, which stays a VPU op.  Digit-conv terms
+# are <= 64 * 63^2 < 2^18; recombining digit pairs into 12-bit limb
+# positions gives values < 2^25 — exact in int32, within _carry range.
+
+DIGITS = 2 * N_LIMBS  # 64 radix-64 digits per field element
+
+
+def _toeplitz_digits(const_limbs: np.ndarray, n_out: int) -> np.ndarray:
+    """Shared-constant conv as a matrix: M[i, k] = digit[k - i] of the
+    constant, so x_digits @ M == digit-conv(x, const)[:n_out]."""
+    digs = np.zeros(DIGITS, np.int64)
+    digs[0::2] = const_limbs & 63
+    digs[1::2] = const_limbs >> 6
+    idx = np.arange(n_out)[None, :] - np.arange(DIGITS)[:, None]
+    ok = (idx >= 0) & (idx < DIGITS)
+    return np.where(ok, digs[np.clip(idx, 0, DIGITS - 1)], 0).astype(np.int8)
+
+
+# low product (mod R == digit truncation at 64: dropped terms carry
+# weight 64^64 = 2^384) and full product matrices
+T_PINV_LOW = _toeplitz_digits(PINV_LIMBS, DIGITS)  # [64, 64]
+T_P_FULL = _toeplitz_digits(P_LIMBS, 2 * DIGITS - 1)  # [64, 127]
+
+
+def limbs_to_digits(x: jax.Array) -> jax.Array:
+    """[..., 32] canonical 12-bit limbs -> [..., 64] 6-bit digits int8."""
+    lo = (x & 63).astype(jnp.int8)
+    hi = (x >> 6).astype(jnp.int8)
+    return jnp.stack([lo, hi], axis=-1).reshape(*x.shape[:-1], DIGITS)
+
+
+def digits_to_limbs(cd: jax.Array) -> jax.Array:
+    """[..., D] digit-conv values (int32) -> [..., ceil(D/2)] 12-bit limb
+    positions (uncarried)."""
+    if cd.shape[-1] % 2:
+        cd = jnp.pad(cd, [(0, 0)] * (cd.ndim - 1) + [(0, 1)])
+    return cd[..., 0::2] + (cd[..., 1::2] << 6)
+
 
 # ---------------------------------------------------------------------------
 # Limb-vector primitives (everything batched over leading axes)
@@ -112,6 +165,20 @@ def _conv(a: jax.Array, b: jax.Array, idx, mask) -> jax.Array:
     """
     b_exp = jnp.take(b, jnp.asarray(idx), axis=-1) * jnp.asarray(mask)
     return jnp.einsum("...i,...ki->...k", a, b_exp)
+
+
+def _conv_shift(a: jax.Array, b: jax.Array, n_out: int) -> jax.Array:
+    """Per-lane conv as 32 shifted broadcast-MACs — no gathered [..., 63,
+    32] intermediate, ~half the multiplies of the masked einsum (only
+    real terms), and measured ~5x the einsum's TPU throughput."""
+    out = None
+    for i in range(N_LIMBS):
+        hi_pad = n_out - i - N_LIMBS
+        term = a[..., i : i + 1] * (b if hi_pad >= 0 else b[..., :hi_pad])
+        pad = [(0, 0)] * (term.ndim - 1) + [(i, max(hi_pad, 0))]
+        term = jnp.pad(term, pad)
+        out = term if out is None else out + term
+    return out
 
 
 def _carry(x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -148,14 +215,93 @@ def _sub_limbs(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
     return jnp.moveaxis(limbs, 0, -1), borrow
 
 
+# -- scanless (Kogge-Stone) carries ----------------------------------------
+#
+# lax.scan carries serialize 32-64 tiny steps; on TPU the KS form (3 bulk
+# limb-folding passes + log2(width) lookahead levels) is both shallower
+# and faster.  XLA:CPU compiles the KS graphs pathologically (minutes),
+# so the CPU/test path keeps the scans — fp12_circuit discovered this
+# split in round 2; round 3 moves it into the shared kernels.
+
+
+def _use_ks() -> bool:
+    return _use_mxu()
+
+
+def _shift_up(x: jax.Array, d: int) -> jax.Array:
+    pad_shape = x.shape[:-1] + (d,)
+    return jnp.concatenate([jnp.zeros(pad_shape, x.dtype), x[..., :-d]], axis=-1)
+
+
+def _ks_resolve(g: jax.Array, p: jax.Array) -> jax.Array:
+    """G[i] = carry/borrow out of prefix [0..i]; 2^levels >= width."""
+    d = 1
+    n = g.shape[-1]
+    while d < n:
+        g = g | (p & _shift_up(g, d))
+        p = p & _shift_up(p, d)
+        d *= 2
+    return g
+
+
+def _carry_ks(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Same contract as _carry (values < 2^31 - 2^19)."""
+    carry_out = jnp.zeros(x.shape[:-1], x.dtype)
+    for _ in range(3):
+        lo = x & LIMB_MASK
+        hi = x >> LIMB_BITS
+        carry_out = carry_out + hi[..., -1]
+        x = lo + _shift_up(hi, 1)
+    g = x >> LIMB_BITS != 0
+    p = (x & LIMB_MASK) == LIMB_MASK
+    G = _ks_resolve(g, p)
+    c_in = jnp.concatenate(
+        [jnp.zeros(x.shape[:-1] + (1,), bool), G[..., :-1]], axis=-1
+    ).astype(x.dtype)
+    carry_out = carry_out + G[..., -1].astype(x.dtype)
+    return (x + c_in) & LIMB_MASK, carry_out
+
+
+def _sub_ks(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Same contract as _sub_limbs (canonical 12-bit inputs)."""
+    t = a - b
+    g = t < 0
+    p = t == 0
+    G = _ks_resolve(g, p)
+    c_in = jnp.concatenate(
+        [jnp.zeros(a.shape[:-1] + (1,), bool), G[..., :-1]], axis=-1
+    ).astype(a.dtype)
+    return (t - c_in) & LIMB_MASK, G[..., -1].astype(a.dtype)
+
+
+def _carry_any(x):
+    return _carry_ks(x) if _use_ks() else _carry(x)
+
+
+def _sub_any(a, b):
+    return _sub_ks(a, b) if _use_ks() else _sub_limbs(a, b)
+
+
 def _cond_sub_p(r: jax.Array) -> jax.Array:
     """r in [0, 2p) -> r mod p."""
-    d, borrow = _sub_limbs(r, jnp.asarray(P_LIMBS))
+    d, borrow = _sub_any(r, jnp.asarray(P_LIMBS))
     return jnp.where((borrow == 0)[..., None], d, r)
 
 
-def fq_mul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Montgomery product: a * b * R^-1 mod p (inputs/outputs in [0, p))."""
+def _use_mxu() -> bool:
+    """One resolver for the whole kernel family: True selects the TPU
+    production tier (mxu convs AND KS carries), False the CPU/test tier
+    (einsum convs AND scan carries).  _use_ks is an alias so the carry
+    choice can never drift from the conv choice."""
+    if _FQ_PATH_ENV == "mxu":
+        return True
+    if _FQ_PATH_ENV == "einsum":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _fq_mul_einsum(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Round-2 fq_mul: gather+einsum convs, scan carries (CPU path)."""
     c = _conv(a, b, _IDX_FULL_C, _MASK_FULL)  # [..., 63]
     c, cc = _carry(c)
     cn = jnp.concatenate([c, cc[..., None]], axis=-1)  # [..., 64]
@@ -168,14 +314,46 @@ def fq_mul(a: jax.Array, b: jax.Array) -> jax.Array:
     return _cond_sub_p(t[..., N_LIMBS:])  # exact division by R = limb shift
 
 
+def _fq_mul_mxu(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Round-3 fq_mul: per-lane shifted-MAC conv (VPU) + the two
+    constant convolutions as shared int8 Toeplitz matmuls (MXU) + KS
+    carries.  Bit-identical to _fq_mul_einsum; ~2.4x its measured TPU
+    throughput (experiments/conv_bench.py)."""
+    c = _conv_shift(a, b, 2 * N_LIMBS - 1)
+    c, cc = _carry_ks(c)
+    cn = jnp.concatenate([c, cc[..., None]], axis=-1)  # [..., 64]
+    cd = limbs_to_digits(cn[..., :N_LIMBS])
+    md = jnp.einsum(
+        "...i,ik->...k",
+        cd,
+        jnp.asarray(T_PINV_LOW),
+        preferred_element_type=jnp.int32,
+    )
+    m, _ = _carry_ks(digits_to_limbs(md))
+    mpd = jnp.einsum(
+        "...i,ik->...k",
+        limbs_to_digits(m),
+        jnp.asarray(T_P_FULL),
+        preferred_element_type=jnp.int32,
+    )
+    t = cn + digits_to_limbs(mpd)  # [..., 64] positions, < 2^26
+    t, _ = _carry_ks(t)
+    return _cond_sub_p(t[..., N_LIMBS:])
+
+
+def fq_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Montgomery product: a * b * R^-1 mod p (inputs/outputs in [0, p))."""
+    return _fq_mul_mxu(a, b) if _use_mxu() else _fq_mul_einsum(a, b)
+
+
 def fq_add(a: jax.Array, b: jax.Array) -> jax.Array:
-    s, _ = _carry(a + b)  # < 2p < 2^382: no carry-out
+    s, _ = _carry_any(a + b)  # < 2p < 2^382: no carry-out
     return _cond_sub_p(s)
 
 
 def fq_sub(a: jax.Array, b: jax.Array) -> jax.Array:
-    d, borrow = _sub_limbs(a, b)
-    dp, _ = _carry(d + jnp.asarray(P_LIMBS))
+    d, borrow = _sub_any(a, b)
+    dp, _ = _carry_any(d + jnp.asarray(P_LIMBS))
     return jnp.where((borrow == 1)[..., None], dp, d)
 
 

@@ -39,8 +39,8 @@ from .bls_jax import (
     LIMB_BITS,
     LIMB_MASK,
     N_LIMBS,
-    _carry,
-    _sub_limbs,
+    _carry_any,
+    _sub_any,
     fq_mul,
     int_to_limbs,
 )
@@ -60,97 +60,19 @@ _OFFSET_64P = _to_limbs_wide(64 * P, _WIDE)
 _KP_WIDE = [_to_limbs_wide(k * P, _WIDE) for k in (64, 32, 16, 8, 4, 2, 1)]
 
 
-# -- scanless carry/borrow (circuit-local) ----------------------------------
-# The general limb kernels keep lax.scan carries (fastest to compile for
-# their small op counts); the circuit path replaces every carry with
-# bulk passes + Kogge-Stone lookahead so the big pairing scan bodies
-# have NO nested sequential loops — runtime depth is what matters when
-# one scan body holds hundreds of field operations.
-#
-# BACKEND-CONDITIONAL: the TPU compiler digests the KS graphs fine and
-# the runtime win is ~2x; XLA:CPU compiles them pathologically (>10
-# min), so on CPU the circuits fall back to the scan-based carries —
-# ~40 s compiles at the cost of sequential-depth runtime (tests use
-# tiny batches anyway).
+# -- scanless carry/borrow ---------------------------------------------------
+# Round 2 discovered the backend split (KS carries are a ~2x TPU runtime
+# win but XLA:CPU compiles them pathologically); round 3 moved the KS
+# primitives and the int8-MXU fq_mul into bls_jax as the shared
+# production path.  The circuit runtime now just reuses them —
+# _fq_mul_ks is bls_jax's backend-dispatching fq_mul (mxu path on TPU).
+from .bls_jax import (  # noqa: F401  (re-exported: tests pin these)
+    _carry_ks,
+    _sub_ks,
+    _use_ks,
+)
 
-
-def _use_ks() -> bool:
-    import jax as _jax
-
-    return _jax.default_backend() == "tpu"
-
-
-def _shift_up(x: jax.Array, d: int):
-    pad_shape = x.shape[:-1] + (d,)
-    return jnp.concatenate(
-        [jnp.zeros(pad_shape, x.dtype), x[..., :-d]], axis=-1
-    )
-
-
-def _ks_resolve(g: jax.Array, p: jax.Array) -> jax.Array:
-    """G[i] = carry/borrow out of prefix [0..i]; 2^levels >= width."""
-    d = 1
-    n = g.shape[-1]
-    while d < n:
-        g = g | (p & _shift_up(g, d))
-        p = p & _shift_up(p, d)
-        d *= 2
-    return g
-
-
-def _carry_ks(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Same contract as bls_jax._carry (values < 2^31 - 2^19)."""
-    carry_out = jnp.zeros(x.shape[:-1], x.dtype)
-    for _ in range(3):
-        lo = x & LIMB_MASK
-        hi = x >> LIMB_BITS
-        carry_out = carry_out + hi[..., -1]
-        x = lo + _shift_up(hi, 1)
-    g = x >> LIMB_BITS != 0
-    p = (x & LIMB_MASK) == LIMB_MASK
-    G = _ks_resolve(g, p)
-    c_in = jnp.concatenate(
-        [jnp.zeros(x.shape[:-1] + (1,), bool), G[..., :-1]], axis=-1
-    ).astype(x.dtype)
-    carry_out = carry_out + G[..., -1].astype(x.dtype)
-    return (x + c_in) & LIMB_MASK, carry_out
-
-
-def _sub_ks(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Same contract as bls_jax._sub_limbs (canonical 12-bit inputs)."""
-    t = a - b
-    g = t < 0
-    p = t == 0
-    G = _ks_resolve(g, p)
-    c_in = jnp.concatenate(
-        [jnp.zeros(a.shape[:-1] + (1,), bool), G[..., :-1]], axis=-1
-    ).astype(a.dtype)
-    return (t - c_in) & LIMB_MASK, G[..., -1].astype(a.dtype)
-
-
-def _fq_mul_ks(a: jax.Array, b: jax.Array) -> jax.Array:
-    """bls_jax.fq_mul with scanless carries (identical math)."""
-    from .bls_jax import (
-        P_LIMBS,
-        PINV_LIMBS,
-        _IDX_FULL_C,
-        _IDX_LOW_C,
-        _MASK_FULL,
-        _MASK_LOW,
-        _conv,
-    )
-
-    c = _conv(a, b, _IDX_FULL_C, _MASK_FULL)
-    c, cc = _carry_ks(c)
-    cn = jnp.concatenate([c, cc[..., None]], axis=-1)
-    m = _conv(cn[..., :N_LIMBS], jnp.asarray(PINV_LIMBS), _IDX_LOW_C, _MASK_LOW)
-    m, _ = _carry_ks(m)
-    mp = _conv(m, jnp.asarray(P_LIMBS), _IDX_FULL_C, _MASK_FULL)
-    t = cn + jnp.pad(mp, [(0, 0)] * (mp.ndim - 1) + [(0, 1)])
-    t, _ = _carry_ks(t)
-    r = t[..., N_LIMBS:]
-    d, borrow = _sub_ks(r, jnp.asarray(P_LIMBS))
-    return jnp.where((borrow == 0)[..., None], d, r)
+_fq_mul_ks = fq_mul
 
 
 # ---------------------------------------------------------------------------
@@ -306,8 +228,7 @@ class Circuit:
 
     @staticmethod
     def _mix(M: np.ndarray, have: jax.Array) -> jax.Array:
-        carry = _carry_ks if _use_ks() else _carry
-        sub = _sub_ks if _use_ks() else _sub_limbs
+        carry, sub = _carry_any, _sub_any
         pos = np.where(M > 0, M, 0).astype(np.int32)
         neg = np.where(M < 0, -M, 0).astype(np.int32)
         t = jnp.einsum(
@@ -335,6 +256,5 @@ class Circuit:
         for SL, SR in self.mats:
             L = self._mix(SL, have)
             R = self._mix(SR, have)
-            prod = _fq_mul_ks(L, R) if _use_ks() else fq_mul(L, R)
-            have = jnp.concatenate([have, prod], axis=-2)
+            have = jnp.concatenate([have, fq_mul(L, R)], axis=-2)
         return self._mix(self.T, have)

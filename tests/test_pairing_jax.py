@@ -90,8 +90,13 @@ def test_ks_carry_kernels_match_scan_reference(rng):
     ]
     A = jnp.asarray(np.stack([bj.int_to_limbs(x) for x, _ in vals]))
     B = jnp.asarray(np.stack([bj.int_to_limbs(y) for _, y in vals]))
-    want = np.asarray(bj.fq_mul(A, B))
-    got = np.asarray(fc._fq_mul_ks(A, B))
+    want = np.asarray(bj.fq_mul(A, B))  # CPU default: einsum/scan path
+    saved = bj._FQ_PATH_ENV
+    try:
+        bj._FQ_PATH_ENV = "mxu"  # force the TPU production path on CPU
+        got = np.asarray(fc._fq_mul_ks(A, B))
+    finally:
+        bj._FQ_PATH_ENV = saved
     assert np.array_equal(got, want)
 
     # raw carry on conv-range magnitudes (incl. ripple-heavy patterns)

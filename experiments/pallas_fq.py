@@ -1,0 +1,160 @@
+"""Pallas fused fq_mul prototype — transposed layout [32, B].
+
+The XLA-composed kernels plateau at ~16-20 ns/fq_mul because every op
+group round-trips VMEM<->HBM and the [.., 32]-last layout wastes 3/4 of
+each lane row.  One Pallas kernel holding the whole Montgomery pipeline
+in VMEM (conv + carries + Toeplitz digit matmuls) targets the ~1-2 ns
+compute+stream bound.
+
+Mosaic constraint: no strided tensor slicing — digits live as SPLIT
+lo/hi planes (concat, not interleave) and the Toeplitz matrices are
+host-side permuted/split into even/odd output-column halves so limb
+recombination is matmul + shift, never a gather.
+
+Run: python experiments/pallas_fq.py [B] [R] [blk]
+"""
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from hydrabadger_tpu.crypto.bls12_381 import P
+from hydrabadger_tpu.ops.bls_jax import (
+    LIMB_MASK,
+    N_LIMBS,
+    P_LIMBS,
+    R_MONT,
+    T_P_FULL,
+    T_PINV_LOW,
+    ints_to_limbs_batch,
+    limbs_to_ints_batch,
+)
+
+from hydrabadger_tpu.ops.fq_T import (
+    PF_EV,
+    PF_OD,
+    PINV_EV,
+    PINV_OD,
+    _carry_ks_rows,
+    _shared_conv,
+    _sub_ks_rows,
+)
+
+D = 2 * N_LIMBS
+PL_ROWS = np.asarray(P_LIMBS, np.int32)[:, None]  # [32, 1]
+
+
+def _fq_mul_body(a, b, pinv_ev, pinv_od, pf_ev, pf_od, p_rows):
+    """Full Montgomery pipeline on [32, B] rows."""
+    rows = []
+    for k in range(2 * N_LIMBS - 1):
+        acc = None
+        for i in range(max(0, k - N_LIMBS + 1), min(N_LIMBS - 1, k) + 1):
+            t = a[i : i + 1] * b[k - i : k - i + 1]  # [1, B], static slices
+            acc = t if acc is None else acc + t
+        rows.append(acc)
+    rows.append(jnp.zeros_like(rows[0]))
+    c = jnp.concatenate(rows, axis=0)  # [64, B]
+    cn = _carry_ks_rows(c)
+    m = _carry_ks_rows(_shared_conv(cn[:N_LIMBS], pinv_ev, pinv_od))
+    t = cn + _shared_conv(m, pf_ev, pf_od)
+    t = _carry_ks_rows(t)
+    r = t[N_LIMBS:]
+    d, borrow = _sub_ks_rows(r, p_rows)
+    return jnp.where(borrow == 0, d, r)
+
+
+def fq_mul_kernel(a_ref, b_ref, pe_ref, po_ref, fe_ref, fo_ref, p_ref, o_ref):
+    o_ref[:] = _fq_mul_body(
+        a_ref[:], b_ref[:], pe_ref[:], po_ref[:], fe_ref[:], fo_ref[:],
+        p_ref[:],
+    )
+
+
+def make_fq_mul_pallas(B: int, blk: int):
+    grid = B // blk
+
+    def call(a, b):
+        return pl.pallas_call(
+            fq_mul_kernel,
+            out_shape=jax.ShapeDtypeStruct((N_LIMBS, B), jnp.int32),
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((N_LIMBS, blk), lambda i: (0, i)),
+                pl.BlockSpec((N_LIMBS, blk), lambda i: (0, i)),
+                pl.BlockSpec((D, N_LIMBS), lambda i: (0, 0)),
+                pl.BlockSpec((D, N_LIMBS), lambda i: (0, 0)),
+                pl.BlockSpec((D, D), lambda i: (0, 0)),
+                pl.BlockSpec((D, D), lambda i: (0, 0)),
+                pl.BlockSpec((N_LIMBS, 1), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((N_LIMBS, blk), lambda i: (0, i)),
+        )(
+            a, b,
+            jnp.asarray(PINV_EV), jnp.asarray(PINV_OD),
+            jnp.asarray(PF_EV), jnp.asarray(PF_OD),
+            jnp.asarray(PL_ROWS),
+        )
+
+    return call
+
+
+def _sync(x):
+    jax.device_get(x.reshape(-1)[:1])
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    R = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    blk = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    print(f"backend={jax.default_backend()} B={B} blk={blk}", flush=True)
+
+    rng = np.random.default_rng(0)
+    a_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 31337]
+    b_int = [int(x) % P for x in rng.integers(0, 2**62, B) * 271828]
+    aT = jax.device_put(jnp.asarray(ints_to_limbs_batch(a_int)).T)
+    bT = jax.device_put(jnp.asarray(ints_to_limbs_batch(b_int)).T)
+
+    mul = make_fq_mul_pallas(B, blk)
+
+    got = limbs_to_ints_batch(np.asarray(jax.device_get(mul(aT, bT))).T[:8])
+    rinv = pow(R_MONT, -1, P)
+    want = [x * y * rinv % P for x, y in zip(a_int[:8], b_int[:8])]
+    print("exact:", got == want, flush=True)
+    if got != want:
+        return
+
+    @partial(jax.jit, static_argnames=("r",))
+    def chain(a, b, r):
+        def body(x, _):
+            return mul(x, b), None
+
+        out, _ = jax.lax.scan(body, a, None, length=r)
+        return out
+
+    for r in (R // 8, R):
+        _sync(chain(aT, bT, r))
+    ts = {}
+    for r in (R // 8, R, R // 8, R):
+        t0 = time.perf_counter()
+        _sync(chain(aT, bT, r))
+        ts[r] = min(ts.get(r, 9e9), time.perf_counter() - t0)
+    per = (ts[R] - ts[R // 8]) / (R - R // 8)
+    print(
+        f"pallas_T blk={blk}: {per/B*1e9:7.2f} ns/fq_mul "
+        f"({B/per/1e6:7.1f} M/s)",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

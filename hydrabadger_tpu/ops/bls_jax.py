@@ -513,6 +513,7 @@ assert bls.normalize(
 )[0] == _target[0]
 GLV_BETA = _beta
 BETA_MONT = int_to_limbs(GLV_BETA * R_MONT % P)
+BETA_COL = np.asarray(BETA_MONT, np.int32)[:, None]  # fq_T column form
 GLV_WINDOWS = 33  # 132 bits cover the 129-bit k2 = k // lambda
 
 
@@ -534,11 +535,33 @@ def scalars_to_glv_windows(scalars: Sequence[int]):
     )
 
 
-@jax.jit
 def jac_scalar_mul_glv(
     points: jax.Array, win1: jax.Array, win2: jax.Array
 ) -> jax.Array:
-    """GLV dual-table ladder: [..., 3, 32] x two [..., 33] window sets."""
+    """GLV dual-table ladder: [..., 3, 32] x two [..., 33] window sets.
+
+    On TPU this dispatches to the fq_T Pallas ladder (transposed
+    layout, whole point ops fused in VMEM — measured ~5.9x this file's
+    composed kernels); the XLA form below remains the CPU/test path."""
+    if _use_mxu():
+        from . import fq_T
+
+        batch = points.shape[:-2]
+        flat = int(np.prod(batch)) if batch else 1
+        out = fq_T.jac_scalar_mul_glv_T(
+            points.reshape(flat, 3, N_LIMBS),
+            win1.reshape(flat, -1),
+            win2.reshape(flat, -1),
+            jnp.asarray(BETA_COL),
+        )
+        return out.reshape(*batch, 3, N_LIMBS)
+    return _jac_scalar_mul_glv_xla(points, win1, win2)
+
+
+@jax.jit
+def _jac_scalar_mul_glv_xla(
+    points: jax.Array, win1: jax.Array, win2: jax.Array
+) -> jax.Array:
     batch = points.shape[:-2]
 
     def tbl_step(prev, _):
@@ -576,12 +599,27 @@ def jac_scalar_mul_glv(
     return acc
 
 
-@jax.jit
 def jac_scalar_mul_windowed(points: jax.Array, windows: jax.Array) -> jax.Array:
     """Fixed-window (w=4) scalar mul: ~2x fewer field muls than
-    double-and-add.
+    double-and-add.  TPU dispatches to the fq_T Pallas ladder; the XLA
+    form below is the CPU/test path."""
+    if _use_mxu():
+        from . import fq_T
 
-    points: [..., 3, 32], windows: [..., n_windows] MSB-first 4-bit
+        batch = points.shape[:-2]
+        flat = int(np.prod(batch)) if batch else 1
+        out = fq_T.jac_scalar_mul_windowed_T(
+            points.reshape(flat, 3, N_LIMBS), windows.reshape(flat, -1)
+        )
+        return out.reshape(*batch, 3, N_LIMBS)
+    return _jac_scalar_mul_windowed_xla(points, windows)
+
+
+@jax.jit
+def _jac_scalar_mul_windowed_xla(
+    points: jax.Array, windows: jax.Array
+) -> jax.Array:
+    """points: [..., 3, 32], windows: [..., n_windows] MSB-first 4-bit
     digits.  Per lane: precompute T = [inf, P, 2P, ..., 15P] (14 adds +
     1 double), then each window costs 4 doubles + 1 table-add, with the
     table lookup as a one-hot einsum — no gathers, no data-dependent

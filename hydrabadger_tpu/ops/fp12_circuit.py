@@ -20,10 +20,14 @@ linear combinations; each `mul` schedules a product lane.  The recorded
 (S_left, S_right, T) matrices ARE the circuit — correct by
 construction, pinned by bit-equality tests against the oracle.
 
-Normalization: mixed values lie in (-Kp, Kp) with K <= 64.  They are
-offset by 64p, carried in a 35-limb working width, then canonicalised
-by a conditional-subtraction ladder of 64p/32p/16p/8p/4p/2p/p — all
-vector ops over the lane axis.
+Normalization: a mix whose rows are each a single +1 coefficient is a
+pure selection — evaluated as a gather with no normalize pass.  Any
+other mix's values lie in (-Kp, Kp) where K is the next power of two
+>= the matrix's max row mass (capped at 64): they are offset by Kp,
+carried in a 35-limb working width, then canonicalised by a
+conditional-subtraction ladder Kp, Kp/2, ..., p — all vector ops over
+the lane axis, and on TPU the mix itself is one signed-int8 digit
+matmul on the MXU.
 """
 from __future__ import annotations
 
@@ -41,6 +45,7 @@ from .bls_jax import (
     N_LIMBS,
     _carry_any,
     _sub_any,
+    _use_mxu,
     fq_mul,
     int_to_limbs,
 )
@@ -56,8 +61,6 @@ def _to_limbs_wide(n: int, width: int) -> np.ndarray:
     )
 
 
-_OFFSET_64P = _to_limbs_wide(64 * P, _WIDE)
-_KP_WIDE = [_to_limbs_wide(k * P, _WIDE) for k in (64, 32, 16, 8, 4, 2, 1)]
 
 
 # -- scanless carry/borrow ---------------------------------------------------
@@ -228,19 +231,46 @@ class Circuit:
 
     @staticmethod
     def _mix(M: np.ndarray, have: jax.Array) -> jax.Array:
-        carry, sub = _carry_any, _sub_any
-        pos = np.where(M > 0, M, 0).astype(np.int32)
-        neg = np.where(M < 0, -M, 0).astype(np.int32)
-        t = jnp.einsum(
-            "ol,...lk->...ok", jnp.asarray(pos), have
-        ) - jnp.einsum("ol,...lk->...ok", jnp.asarray(neg), have)
-        # normalize: offset +64p, wide carry, cond-sub ladder
+        mass = int(np.abs(M).sum(axis=1).max(initial=0))
+        # pure-selection mix (every row is one +1, or empty): a gather —
+        # values are already canonical, no normalize pass at all
+        if mass <= 1 and M.min(initial=0) >= 0:
+            idx = np.argmax(M, axis=1)
+            nz = (M.sum(axis=1) > 0).astype(np.int32)[:, None]
+            return jnp.take(have, jnp.asarray(idx), axis=-2) * jnp.asarray(nz)
+        if _use_mxu():
+            # one signed int8 digit matmul on the MXU: |digit sums| <=
+            # mass * 63 < 2^12, limb positions < mass * 63 * 65 < 2^19
+            from .bls_jax import digits_to_limbs, limbs_to_digits
+
+            dig = limbs_to_digits(have)
+            td = jnp.einsum(
+                "ol,...li->...oi",
+                jnp.asarray(M.astype(np.int8)),
+                dig,
+                preferred_element_type=jnp.int32,
+            )
+            t = digits_to_limbs(td)
+        else:
+            pos = np.where(M > 0, M, 0).astype(np.int32)
+            neg = np.where(M < 0, -M, 0).astype(np.int32)
+            t = jnp.einsum(
+                "ol,...lk->...ok", jnp.asarray(pos), have
+            ) - jnp.einsum("ol,...lk->...ok", jnp.asarray(neg), have)
+        # normalize: offset +Kp (K = pow2 >= row mass, so t + Kp >= 0),
+        # wide carry, then a cond-sub ladder sized to K instead of the
+        # fixed 64 — selection-light layers pay 1-3 subs, not 7
+        k = 1
+        while k < mass:
+            k *= 2
         pad = [(0, 0)] * (t.ndim - 1) + [(0, _WIDE - N_LIMBS)]
-        t = jnp.pad(t, pad) + jnp.asarray(_OFFSET_64P)
-        t, _ = carry(t)
-        for kp in _KP_WIDE:
-            d, borrow = sub(t, jnp.asarray(kp))
+        t = jnp.pad(t, pad) + jnp.asarray(_to_limbs_wide(k * P, _WIDE))
+        t, _ = _carry_any(t)
+        kp = k
+        while kp >= 1:
+            d, borrow = _sub_any(t, jnp.asarray(_to_limbs_wide(kp * P, _WIDE)))
             t = jnp.where((borrow == 0)[..., None], d, t)
+            kp //= 2
         return t[..., :N_LIMBS]
 
     def __call__(self, inputs: jax.Array) -> jax.Array:

@@ -204,17 +204,19 @@ def _sparse035(f: S12, a0: S2, a3: S2, a5: S2) -> S12:
 
 
 @lru_cache(maxsize=None)
-def _miller_step_circuit():
+def _miller_dbl_circuit():
     """Inputs: f(12) R(6: X,Y,Z as Fp2 pairs) qx(2) qy(2) px(1) py(1) =
-    24.  Outputs: f_dbl(12), R_dbl(6), f_add(12), R_add(6) — the runtime
-    selects the add variant on set ate bits."""
+    24.  Outputs: f_dbl(12), R_dbl(6) — one squaring-and-tangent Miller
+    iteration.  The ate bits are STATIC, so the loop is segmented into
+    runs of these double-only steps with _miller_add_circuit applied
+    once per in-loop set bit (5 of the 63 scanned bits; the 6th set
+    bit of |x| is the implicit leading one) — the round-2 combined circuit paid the
+    chord-and-add lanes on every iteration."""
     b = CircuitBuilder(24)
     f = _s12_from_inputs(b, 0)
     X = _s2_from_inputs(b, 12)
     Y = _s2_from_inputs(b, 14)
     Z = _s2_from_inputs(b, 16)
-    qx = _s2_from_inputs(b, 18)
-    qy = _s2_from_inputs(b, 20)
     px, py = b.input(22), b.input(23)
 
     f2 = f.sqr()
@@ -238,39 +240,114 @@ def _miller_step_circuit():
     Rd_y = W * (B4 - H) - (YY * S2_).dbl().dbl().dbl()
     Rd_z = (S * S2_).dbl().dbl().dbl()
 
-    # chord line + mixed add from the doubled point
-    lam = qy * Rd_z - Rd_y
-    dl = qx * Rd_z - Rd_x
-    b0 = -(dl.mul_fp(py) * Rd_z).mul_xi()
-    b3 = dl * Rd_y - lam * Rd_x
-    b5 = (lam * Rd_z).mul_fp(px)
-    fa = _sparse035(fd, b0, b3, b5)
-    l2 = lam * lam
-    d2 = dl * dl
-    d3 = d2 * dl
-    d2x = d2 * Rd_x
-    A = l2 * Rd_z - d3 - d2x.dbl()
-    Ra_x = dl * A
-    Ra_y = lam * (d2x - A) - d3 * Rd_y
-    Ra_z = d3 * Rd_z
-
-    outs = (
-        fd.coeffs()
-        + [*Rd_x.c, *Rd_y.c, *Rd_z.c]
-        + fa.coeffs()
-        + [*Ra_x.c, *Ra_y.c, *Ra_z.c]
-    )
+    outs = fd.coeffs() + [*Rd_x.c, *Rd_y.c, *Rd_z.c]
     return b.compile(outs)
 
 
 @lru_cache(maxsize=None)
-def _sqr_mul_circuit():
-    """Inputs: f(12), base(12).  Outputs: sqr(f)(12), sqr(f)*base(12)."""
+def _miller_add_circuit():
+    """Inputs as _miller_dbl_circuit.  Outputs: f_add(12), R_add(6) —
+    the chord-and-mixed-add applied at a set ate bit (after the double
+    of that iteration)."""
     b = CircuitBuilder(24)
     f = _s12_from_inputs(b, 0)
-    base = _s12_from_inputs(b, 12)
-    s = f.sqr()
-    return b.compile(s.coeffs() + (s * base).coeffs())
+    X = _s2_from_inputs(b, 12)
+    Y = _s2_from_inputs(b, 14)
+    Z = _s2_from_inputs(b, 16)
+    qx = _s2_from_inputs(b, 18)
+    qy = _s2_from_inputs(b, 20)
+    px, py = b.input(22), b.input(23)
+
+    lam = qy * Z - Y
+    dl = qx * Z - X
+    b0 = -(dl.mul_fp(py) * Z).mul_xi()
+    b3 = dl * Y - lam * X
+    b5 = (lam * Z).mul_fp(px)
+    fa = _sparse035(f, b0, b3, b5)
+    l2 = lam * lam
+    d2 = dl * dl
+    d3 = d2 * dl
+    d2x = d2 * X
+    A = l2 * Z - d3 - d2x.dbl()
+    Ra_x = dl * A
+    Ra_y = lam * (d2x - A) - d3 * Y
+    Ra_z = d3 * Z
+
+    outs = fa.coeffs() + [*Ra_x.c, *Ra_y.c, *Ra_z.c]
+    return b.compile(outs)
+
+
+@lru_cache(maxsize=None)
+def _sqr_circuit():
+    """f(12) -> f^2(12) — the square-only step of segmented pow chains."""
+    b = CircuitBuilder(12)
+    f = _s12_from_inputs(b, 0)
+    return b.compile(f.sqr().coeffs())
+
+
+def _s2_sqr(x: S2) -> S2:
+    """(a + bu)^2 = (a+b)(a-b) + 2ab u — 2 lanes vs Karatsuba's 3."""
+    t = (x.c[0] + x.c[1]) * (x.c[0] - x.c[1])
+    m = x.c[0] * x.c[1]
+    return S2(t, m.dbl())
+
+
+def _fp4_sqr(x0: S2, x1: S2) -> tuple[S2, S2]:
+    """(x0 + x1 y)^2 with y^2 = xi: (x0^2 + xi x1^2, 2 x0 x1) — 6 lanes."""
+    t0 = _s2_sqr(x0)
+    t1 = _s2_sqr(x1)
+    s = _s2_sqr(x0 + x1)
+    return t0 + t1.mul_xi(), s - t0 - t1
+
+
+@lru_cache(maxsize=None)
+def _cyc_sqr_circuit():
+    """Granger-Scott squaring in the cyclotomic subgroup: 18 lanes vs
+    the generic 36.
+
+    Write f = A + B w + C w^2 over Fp4 = Fp2[y]/(y^2 - xi) with y = w^3;
+    in our slot basis (w-powers 0,2,4,1,3,5) the Fp4 pairs are
+    A = (g0, h1), B = (h0, g2), C = (g1, h2).  For unitary f:
+      f^2 = (3A^2 - 2conj(A)) + (3 y C^2 + 2conj(B)) w + (3B^2 - 2conj(C)) w^2
+    with conj(x0 + x1 y) = x0 - x1 y.  Pinned against the generic
+    multiply on genuinely cyclotomic inputs by tests."""
+    b = CircuitBuilder(12)
+    f = _s12_from_inputs(b, 0)
+    g0, g1, g2 = f.g.c
+    h0, h1, h2 = f.h.c
+    a20, a21 = _fp4_sqr(g0, h1)
+    b20, b21 = _fp4_sqr(h0, g2)
+    c20, c21 = _fp4_sqr(g1, h2)
+    three = lambda x: x.dbl() + x
+    # A' = 3A^2 - 2conj(A): (3 a20 - 2 g0, 3 a21 + 2 h1)
+    ng0 = three(a20) - g0.dbl()
+    nh1 = three(a21) + h1.dbl()
+    # B' = 3 y C^2 + 2conj(B): y*(c20 + c21 y) = (xi c21, c20)
+    nh0 = three(c21.mul_xi()) + h0.dbl()
+    ng2 = three(c20) - g2.dbl()
+    # C' = 3B^2 - 2conj(C): (3 b20 - 2 g1, 3 b21 + 2 h2)
+    ng1 = three(b20) - g1.dbl()
+    nh2 = three(b21) + h2.dbl()
+    out = S12(S6(ng0, ng1, ng2), S6(nh0, nh1, nh2))
+    return b.compile(out.coeffs())
+
+
+def _exp_segments(value: int) -> list[int]:
+    """MSB-first square-and-multiply schedule for a STATIC exponent:
+    returns run lengths [r0, r1, ...] — r0 squarings then a multiply,
+    r1 squarings then a multiply, ...; a trailing zero-run is appended
+    as the last element with no multiply after it (callers mul between
+    segments, not after the final one ... the last entry is always the
+    tail run, possibly 0)."""
+    bits = [(value >> i) & 1 for i in range(value.bit_length() - 2, -1, -1)]
+    segs, run = [], 0
+    for bit in bits:
+        run += 1
+        if bit:
+            segs.append(run)
+            run = 0
+    segs.append(run)  # squarings after the last multiply (may be 0)
+    return segs
 
 
 @lru_cache(maxsize=None)
@@ -384,21 +461,30 @@ def _fq12_mul(a, b):
 
 
 def _pow_x_abs(a):
-    """a^|x| via the fused sqr/sqr-mul circuit and the static bits."""
-    bits = np.array(
-        [(X_ABS >> i) & 1 for i in range(X_ABS.bit_length() - 2, -1, -1)],
-        dtype=np.int32,
-    )
-    circ = _sqr_mul_circuit()
+    """a^|x| — segmented square-and-multiply over the STATIC parameter
+    bits: scan runs of square-only circuits, one multiply at each of the
+    5 in-loop set bits (the round-2 fused circuit paid a full Fp12 multiply's
+    lanes on all 63 iterations).
 
-    def step(acc, bit):
-        out = circ(jnp.concatenate([acc, a], axis=-2))
-        sq, sqm = out[..., :12, :], out[..., 12:, :]
-        acc = jnp.where(bit != 0, sqm, sq)
-        return acc, None
+    PRECONDITION: `a` is in the cyclotomic subgroup (every call site is
+    past the easy part), so the square step is the Granger-Scott
+    18-lane circuit, not the generic 36-lane one."""
+    sqr = _cyc_sqr_circuit()
 
-    acc, _ = jax.lax.scan(step, a, jnp.asarray(bits))
-    return acc
+    def sq_run(acc, n):
+        if n == 0:
+            return acc
+        out, _ = jax.lax.scan(
+            lambda c, _: (sqr(c), None), acc, None, length=n
+        )
+        return out
+
+    segs = _exp_segments(X_ABS)
+    acc = a
+    for run in segs[:-1]:
+        acc = sq_run(acc, run)
+        acc = _fq12_mul(acc, a)
+    return sq_run(acc, segs[-1])
 
 
 def _cyc_pow_x(a):
@@ -439,14 +525,13 @@ def _final_exp_is_one(f):
 # Miller loop + public batched checks
 # ---------------------------------------------------------------------------
 
-_ATE_BITS = np.array(
-    [(X_ABS >> i) & 1 for i in range(X_ABS.bit_length() - 2, -1, -1)],
-    dtype=np.int32,
-)
-
-
 def _miller(qx, qy, px, py):
-    """qx,qy: [..., 2, 32]; px,py: [..., 32] -> f [..., 12, 32]."""
+    """qx,qy: [..., 2, 32]; px,py: [..., 32] -> f [..., 12, 32].
+
+    Segmented ate loop: the parameter bits are static, so double-only
+    steps run as scans and the chord-and-add circuit fires exactly at
+    the 5 in-loop set bits instead of being computed-and-discarded every
+    iteration."""
     batch = px.shape[:-1]
     one2 = np.zeros((2, N_LIMBS), np.int32)
     one2[0] = int_to_limbs(R_MONT % P)
@@ -455,22 +540,30 @@ def _miller(qx, qy, px, py):
         [qx, qy, jnp.broadcast_to(jnp.asarray(one2), batch + (2, N_LIMBS))],
         axis=-2,
     )
-    circ = _miller_step_circuit()
+    dbl, add = _miller_dbl_circuit(), _miller_add_circuit()
+    pxl, pyl = px[..., None, :], py[..., None, :]
 
-    def step(carry, bit):
-        f, R = carry
-        inp = jnp.concatenate(
-            [f, R, qx, qy, px[..., None, :], py[..., None, :]], axis=-2
-        )
-        out = circ(inp)
-        fd, Rd = out[..., 0:12, :], out[..., 12:18, :]
-        fa, Ra = out[..., 18:30, :], out[..., 30:36, :]
-        sel = bit != 0
-        f = jnp.where(sel, fa, fd)
-        R = jnp.where(sel, Ra, Rd)
-        return (f, R), None
+    def pack(f, R):
+        return jnp.concatenate([f, R, qx, qy, pxl, pyl], axis=-2)
 
-    (f, _), _ = jax.lax.scan(step, (f, R), jnp.asarray(_ATE_BITS))
+    def dbl_run(f, R, n):
+        if n == 0:
+            return f, R
+
+        def step(carry, _):
+            f, R = carry
+            out = dbl(pack(f, R))
+            return (out[..., 0:12, :], out[..., 12:18, :]), None
+
+        (f, R), _ = jax.lax.scan(step, (f, R), None, length=n)
+        return f, R
+
+    segs = _exp_segments(X_ABS)
+    for run in segs[:-1]:
+        f, R = dbl_run(f, R, run)
+        out = add(pack(f, R))
+        f, R = out[..., 0:12, :], out[..., 12:18, :]
+    f, _ = dbl_run(f, R, segs[-1])
     return f
 
 

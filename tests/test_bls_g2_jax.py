@@ -2,11 +2,15 @@
 threshold-signature batch entry points (TpuEngine vs CpuEngine)."""
 import random
 
+import pytest
+
 from hydrabadger_tpu.crypto import bls12_381 as bls
 from hydrabadger_tpu.crypto import threshold as th
 from hydrabadger_tpu.crypto.engine import CpuEngine, TpuEngine
 from hydrabadger_tpu.ops import bls_g2_jax as g2
 
+
+pytestmark = pytest.mark.slow  # JAX kernel compiles: minutes on XLA:CPU
 
 def test_g2_scalar_mul_and_roundtrip():
     rng = random.Random(0)

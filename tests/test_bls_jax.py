@@ -63,6 +63,7 @@ def test_jac_double_add_match_reference():
         assert bls.eq(got, bls.double(p))
 
 
+@pytest.mark.slow
 def test_scalar_mul_batch_including_edges():
     rng = random.Random(17)
     ks = [0, 1, 2, bls.R - 1, rng.getrandbits(254), rng.getrandbits(64)]
@@ -75,6 +76,7 @@ def test_scalar_mul_batch_including_edges():
     assert bls.is_inf(g)
 
 
+@pytest.mark.slow
 def test_weighted_sum_is_lagrange_combine():
     rng = random.Random(19)
     pts_b, coeff_b, expect = [], [], []
@@ -94,6 +96,7 @@ def test_weighted_sum_is_lagrange_combine():
     assert bls.is_inf(g)
 
 
+@pytest.mark.slow
 def test_engine_threshold_decrypt_parity():
     """TpuEngine batch path == CpuEngine loop path, bytes-for-bytes."""
     rng = random.Random(23)
@@ -124,6 +127,7 @@ def test_engine_threshold_decrypt_parity():
     assert out_tpu == out_cpu == msgs
 
 
+@pytest.mark.slow
 def test_combine_rejects_below_threshold():
     rng = random.Random(29)
     sk_set = th.SecretKeySet.random(1, rng)
@@ -134,6 +138,7 @@ def test_combine_rejects_below_threshold():
         TpuEngine().combine_decryption_shares_batch([(pk_set, {0: share}, ct)])
 
 
+@pytest.mark.slow
 def test_windowed_ladder_matches_bit_ladder_and_oracle():
     """w=4 windows vs the 255-bit ladder vs the pure-Python oracle,
     including the edge scalars 0, 1, R-1."""
@@ -155,6 +160,7 @@ def test_windowed_ladder_matches_bit_ladder_and_oracle():
         assert bls.eq(b, expected)
 
 
+@pytest.mark.slow
 def test_glv_ladder_matches_oracle_edges():
     """GLV decomposition + dual-table ladder vs the oracle, including
     scalars straddling the lambda split."""
@@ -205,3 +211,48 @@ def test_digit_codec_roundtrip():
     assert digs.dtype == jnp.int8 and int(np.max(np.asarray(digs))) <= 63
     back = bj.digits_to_limbs(digs.astype(jnp.int32))
     assert np.array_equal(np.asarray(back), np.asarray(limbs))
+
+
+@pytest.mark.slow
+def test_pallas_T_glv_ladder_bit_exact(monkeypatch):
+    """The fq_T transposed-layout GLV ladder (the TPU production path)
+    must match the oracle when forced on CPU — where it runs the same
+    body functions as plain XLA.  Slow: the XLA:CPU compile of the
+    Kogge-Stone row carries is the known round-2 pathology (~5 min)."""
+    monkeypatch.setattr(bj, "_FQ_PATH_ENV", "mxu")
+    rng = random.Random(41)
+    pts = [bls.multiply(bls.G1, rng.getrandbits(160) + 1) for _ in range(5)]
+    ks = [rng.getrandbits(255) % bls.R for _ in range(4)] + [0]
+    dev = jnp.asarray(bj.points_to_limbs(pts))
+    w1, w2 = bj.scalars_to_glv_windows(ks)
+    got = bj.limbs_to_points(
+        bj.jac_scalar_mul_glv(dev, jnp.asarray(w1), jnp.asarray(w2))
+    )
+    for g, p, k in zip(got, pts, ks):
+        assert bls.eq(g, bls.multiply(p, k)), k
+
+
+def test_pallas_T_point_ops_bit_exact(monkeypatch):
+    """Fast tier: the fq_T point-op bodies (fused mul/double/add) pin
+    against the oracle directly, without a full ladder compile."""
+    from hydrabadger_tpu.ops import fq_T
+
+    rng = random.Random(43)
+    pts = [bls.multiply(bls.G1, rng.getrandbits(120) + 1) for _ in range(4)]
+    other = pts[1:] + pts[:1]
+    a = fq_T.from_points_BC(jnp.asarray(bj.points_to_limbs(pts)))
+    b = fq_T.from_points_BC(jnp.asarray(bj.points_to_limbs(other)))
+    dbl = bj.limbs_to_points(fq_T.to_points_BC(fq_T.jac_double_T(a)))
+    for got, p in zip(dbl, pts):
+        assert bls.eq(got, bls.double(p))
+    added = bj.limbs_to_points(fq_T.to_points_BC(fq_T.jac_add_T(a, b)))
+    for got, p, q in zip(added, pts, other):
+        assert bls.eq(got, bls.add(p, q))
+    # equal-operands lane exercises the doubling arm; infinity arms too
+    eq_add = bj.limbs_to_points(fq_T.to_points_BC(fq_T.jac_add_T(a, a)))
+    for got, p in zip(eq_add, pts):
+        assert bls.eq(got, bls.double(p))
+    inf = fq_T.jac_infinity_T(len(pts))
+    via_inf = bj.limbs_to_points(fq_T.to_points_BC(fq_T.jac_add_T(a, inf)))
+    for got, p in zip(via_inf, pts):
+        assert bls.eq(got, p)

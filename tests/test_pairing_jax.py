@@ -14,6 +14,8 @@ from hydrabadger_tpu.crypto import threshold as th
 from hydrabadger_tpu.crypto.engine import CpuEngine, TpuEngine
 
 
+pytestmark = pytest.mark.slow  # JAX kernel compiles: minutes on XLA:CPU
+
 @pytest.fixture(scope="module")
 def rng():
     return random.Random(0xA1)
@@ -139,3 +141,36 @@ def test_pairing_batch_infinity_lane_does_not_abort(rng):
         cpu.verify_decryption_share(pks.public_key_share(1), inf_share, ct),
     ]
     assert got == want == [True, False]
+
+
+def test_cyclotomic_squaring_matches_generic_multiply(rng):
+    """The Granger-Scott 18-lane squaring circuit must agree with the
+    oracle-pinned generic multiply on genuinely cyclotomic inputs (the
+    image of the easy part), and MUST disagree on a random Fp12 element
+    (proving the test has teeth — GS is only valid for unitary f)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hydrabadger_tpu.ops import bls_jax as bj
+    from hydrabadger_tpu.ops import pairing_jax as pj
+
+    @jax.jit
+    def check(f):
+        u = pj._fq12_mul(pj._fq12_conj(f), pj._fq12_inv(f))
+        m = pj._mul_conj_frob_circuit(2, False)(
+            jnp.concatenate([u, u], axis=-2)
+        )
+        want = pj._mul_circuit()(jnp.concatenate([m, m], axis=-2))
+        got = pj._cyc_sqr_circuit()(m)
+        bad_want = pj._mul_circuit()(jnp.concatenate([f, f], axis=-2))
+        bad_got = pj._cyc_sqr_circuit()(f)
+        return jnp.all(want == got), jnp.all(bad_want == bad_got)
+
+    vals = [rng.getrandbits(381) % bls.P for _ in range(12)]
+    f = jnp.asarray(
+        np.stack([bj.int_to_limbs(v * pj.R_MONT % bls.P) for v in vals])
+    )[None]
+    ok, bad = jax.device_get(check(f))
+    assert bool(ok), "GS squaring broke on a cyclotomic element"
+    assert not bool(bad), "GS squaring cannot equal generic on random f"

@@ -1,5 +1,6 @@
 """Device-resident tensor fast-path simulator (sim/tensor.py)."""
 import numpy as np
+import pytest
 
 from hydrabadger_tpu.sim import tensor as ts
 
@@ -42,6 +43,7 @@ def test_corruption_is_detected():
     assert not ok2[0] and ok2[1]
 
 
+@pytest.mark.slow
 def test_full_crypto_tensor_sim_oracle():
     """The full-crypto device epoch (share ladders + Lagrange combine +
     ciphertext evolution) matches the host threshold-crypto oracle and

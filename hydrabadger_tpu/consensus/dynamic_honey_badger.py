@@ -250,6 +250,27 @@ class DynamicHoneyBadger:
         step = self.hb.propose(internal, rng)
         return self._filter(step)
 
+    def external_contribution(self, contribution: bytes) -> bytes:
+        """The internal payload propose() would feed the ACS — user bytes
+        plus pending votes and keygen messages — for an external (native)
+        ACS run that bypasses the message plane."""
+        votes = []
+        if (
+            self.our_vote is not None
+            and self.votes.get(self.our_id) != self.our_vote
+        ):
+            sig = self.our_sk.sign(self._vote_doc(self.our_vote))
+            votes.append((self.our_id, self.our_vote, sig.to_bytes()))
+        return codec.encode(
+            (bytes(contribution), tuple(votes), tuple(self.pending_kg))
+        )
+
+    def apply_external_batch(self, contributions: dict) -> Step:
+        """Apply an externally-agreed epoch: the full DHB batch pipeline
+        (vote commits, keygen transcript, era switches) runs in _filter's
+        _on_batch exactly as for a message-plane epoch."""
+        return self._filter(self.hb.apply_external_batch(contributions))
+
     @guarded_handler("dhb")
     def handle_message(self, sender, message) -> Step:
         _tag, era, inner = message[0], int(message[1]), message[2]
@@ -276,7 +297,7 @@ class DynamicHoneyBadger:
             session_id=self.session_id,
         )
 
-    def install_share_from_transcript(self, entries) -> bool:
+    def install_share_from_transcript(self, entries, kg_era: int) -> bool:
         """Recover this node's secret share by replaying a committed DKG
         transcript (stranded-joiner healing, beyond the reference — its
         join races are fatal, README.md:44-50).
@@ -303,7 +324,14 @@ class DynamicHoneyBadger:
         }
         if len(pub_keys) != len(self.netinfo.node_ids):
             return False
-        kg = SyncKeyGen(self.our_id, self.our_sk, pub_keys, threshold, self.rng)
+        kg = SyncKeyGen(
+            self.our_id,
+            self.our_sk,
+            pub_keys,
+            threshold,
+            self.rng,
+            session=self._kg_session(kg_era),
+        )
         for proposer, msg in entries:
             # wire transport delivers ids as raw bytes; logic-tier
             # callers pass whatever id type the network uses
@@ -344,6 +372,13 @@ class DynamicHoneyBadger:
 
     # -- internals ----------------------------------------------------------
 
+    def _kg_session(self, era: int) -> bytes:
+        """Per-DKG-instance channel nonce: the era the keygen STARTED in
+        (all live participants share it; stranded joiners get it with the
+        served transcript).  Distinct per instance, so the pairwise
+        channel keystreams never repeat across eras."""
+        return self.session_id + b"/kg-era" + str(era).encode()
+
     def _vote_doc(self, change: tuple) -> bytes:
         return b"DHB-VOTE" + codec.encode((self.era, tuple(change)))
 
@@ -374,6 +409,7 @@ class DynamicHoneyBadger:
     def _on_batch(self, hb_batch: Batch) -> Tuple[DhbBatch, Step]:
         step = Step()
         contributions = {}
+        batch_votes: List[Tuple] = []  # (proposer, vote) in commit order
         for proposer, payload in sorted(hb_batch.contributions.items()):
             try:
                 user, votes, kg_msgs = codec.decode(bytes(payload))
@@ -382,7 +418,7 @@ class DynamicHoneyBadger:
                 continue
             contributions[proposer] = bytes(user)
             for vote in votes:
-                self._commit_vote(proposer, vote, step)
+                batch_votes.append((proposer, vote))
             for kg in kg_msgs:
                 if proposer == self.our_id:
                     # our own keygen msg committed: stop retransmitting it
@@ -391,6 +427,7 @@ class DynamicHoneyBadger:
                         m for m in self.pending_kg if _freeze(m) != kg_t
                     ]
                 self._commit_keygen_msg(proposer, kg, step)
+        self._commit_votes_batch(batch_votes, step)
         self.epoch = self.era + hb_batch.epoch + 1
         change = None
         join_plan = None
@@ -424,6 +461,80 @@ class DynamicHoneyBadger:
             )
         self.batches.append(batch)
         return batch, step
+
+    def _commit_votes_batch(self, batch_votes, step: Step) -> None:
+        """Commit a batch's signed votes with ONE RLC pairing check per
+        distinct vote document instead of one pairing per vote.
+
+        All votes on the same (era, change) share the message, so
+        e(G1, sum r_i sig_i) == e(sum r_i pk_i, H(doc)) verifies the
+        whole group with 2 pairings and short scalar muls (random 64-bit
+        r_i — a forged vote passes with probability 2^-64).  On group
+        failure the per-vote path re-runs for fault attribution, so
+        verdicts and fault logs match the sequential semantics."""
+        import hashlib
+
+        from ..crypto.threshold import Signature
+
+        parsed = []  # (proposer, voter, change, sig)
+        for proposer, vote in batch_votes:
+            try:
+                voter, change, sig_bytes = vote
+                change = tuple(change)
+                sig = Signature.from_bytes(bytes(sig_bytes))
+            except (ValueError, TypeError):
+                step.fault(proposer, "dhb: malformed vote")
+                continue
+            pk = self.pub_keys.get(voter)
+            if pk is None or voter not in self.netinfo._index:
+                step.fault(proposer, "dhb: vote from non-validator")
+                continue
+            parsed.append((proposer, voter, change, sig))
+        if not parsed:
+            return
+        from collections import defaultdict
+
+        from ..crypto import bls12_381 as bls
+        from ..crypto.dkg import rlc_scalars
+
+        groups = defaultdict(list)
+        for idx, item in enumerate(parsed):
+            groups[self._vote_doc(item[2])].append((idx, item))
+        verified: Dict[int, bool] = {}
+        for doc, items in groups.items():
+            if len(items) > 1:
+                # Fiat-Shamir seed binds the doc and every signature in
+                # the group (the data under verification)
+                h_seed = hashlib.sha256()
+                h_seed.update(b"HBTPU-DHB-votes")
+                h_seed.update(doc)
+                for _idx, (_p, voter, _c, sig) in items:
+                    h_seed.update(hashlib.sha256(sig.to_bytes()).digest())
+                rs = rlc_scalars(h_seed.digest(), len(items))
+                hpt = bls.hash_to_g2(doc)
+                agg_sig = bls.infinity(bls.FQ2)
+                agg_pk = bls.infinity(bls.FQ)
+                for r, (_idx, (_p, voter, _c, sig)) in zip(rs, items):
+                    agg_sig = bls.add(agg_sig, bls.mul_sub(sig.point, r))
+                    agg_pk = bls.add(
+                        agg_pk, bls.mul_sub(self.pub_keys[voter].point, r)
+                    )
+                if bls.pairing_check_eq(bls.G1, agg_sig, agg_pk, hpt):
+                    for idx, _item in items:
+                        verified[idx] = True
+                    continue
+                # fall through: attribute faults vote by vote
+            for idx, (_p, voter, change, sig) in items:
+                if self.pub_keys[voter].verify(sig, doc):
+                    verified[idx] = True
+                else:
+                    verified[idx] = False
+                    step.fault(_p, "dhb: bad vote signature")
+        # apply verified votes in COMMIT order (sequential semantics:
+        # the last committed vote per voter wins)
+        for idx, (_p, voter, change, _s) in enumerate(parsed):
+            if verified.get(idx):
+                self.votes[voter] = change
 
     def _commit_vote(self, proposer, vote, step: Step) -> None:
         try:
@@ -484,7 +595,12 @@ class DynamicHoneyBadger:
         if self.our_id in new_ids:
             threshold = (len(new_ids) - 1) // 3
             kg = SyncKeyGen(
-                self.our_id, self.our_sk, new_pub_keys, threshold, self.rng
+                self.our_id,
+                self.our_sk,
+                new_pub_keys,
+                threshold,
+                self.rng,
+                session=self._kg_session(self.era),
             )
             state = _KeyGenState(tuple(change), new_ids, new_pub_keys, kg)
             self.key_gen = state
@@ -536,6 +652,7 @@ class DynamicHoneyBadger:
     def _switch_era(self, step: Step) -> None:
         state = self.key_gen
         new_era = self.epoch
+        kg_era = self.era  # the era this keygen's channel nonces used
         if isinstance(state.key_gen, _RemovedTracker):
             pk_set, sk_share = state.key_gen.generate(), None
         else:
@@ -547,7 +664,7 @@ class DynamicHoneyBadger:
         )
         self.pub_keys = dict(state.new_pub_keys)
         self.era = new_era
-        self.last_transcript = (new_era, tuple(state.transcript))
+        self.last_transcript = (new_era, kg_era, tuple(state.transcript))
         self.hb = self._make_hb()
         self.votes = {}
         self.key_gen = None

@@ -91,6 +91,25 @@ class HoneyBadger:
         step.extend(self._progress(epoch))
         return step
 
+    def apply_external_batch(self, contributions: dict) -> Step:
+        """Install the current epoch's result from an EXTERNAL ACS run
+        (the native C++ engine, sim/native_acs.py): advance the epoch and
+        emit the Batch exactly as _progress would.  Only meaningful on
+        the unencrypted tier — the external world agrees on plaintext
+        contributions, so there is no decrypt stage to drive."""
+        if self.encrypt:
+            raise RuntimeError("apply_external_batch requires encrypt=False")
+        epoch = self.epoch
+        batch = Batch(
+            epoch,
+            {p: bytes(v) for p, v in sorted(contributions.items())},
+        )
+        step = Step()
+        step.output.append(batch)
+        self.epoch = epoch + 1
+        self.epochs.pop(epoch, None)
+        return step
+
     @guarded_handler("hb")
     def handle_message(self, sender, message) -> Step:
         if not self.netinfo.is_validator(sender):

@@ -25,11 +25,14 @@ evaluation points are index+1 (0 is the master).
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 from dataclasses import dataclass, field
 from typing import Dict, Generic, Hashable, List, Mapping, Optional, Tuple, TypeVar
 
 from ..utils import codec
 from . import bls12_381 as bls
+from . import native_bls
 from .bls12_381 import FQ, G1, R, add, eq, g1_from_bytes, g1_to_bytes, infinity, mul_sub, multiply
 from .threshold import (
     Ciphertext,
@@ -45,17 +48,108 @@ from .threshold import (
 N = TypeVar("N", bound=Hashable)
 
 
+def _small_fold(point_matrix, base: int, axis: int):
+    """Native Horner fold by powers of a small base when available."""
+    if native_bls.available() and 0 < base < (1 << 16):
+        try:
+            return native_bls.g1_fold_pow(point_matrix, base, axis)
+        except Exception:  # pragma: no cover - native edge failure
+            pass
+    return None
+
+
 def g1_poly_eval(points, x: int):
     """Evaluate a G1-point polynomial (coefficients low-to-high) at x:
     Σ_j points[j] * x^j — the shared Horner-style accumulation used by
     commitment folding and ack verification (and mirrored by
-    threshold.PublicKeySet.public_key_share)."""
+    threshold.PublicKeySet.public_key_share).  Small x (node indices)
+    takes the native short-Horner path."""
+    fast = _small_fold([list(points)], x, 1)
+    if fast is not None:
+        return fast[0]
     acc = infinity(FQ)
     xj = 1
     for pt in points:
         acc = add(acc, mul_sub(pt, xj))
         xj = xj * x % R
     return acc
+
+
+# ---------------------------------------------------------------------------
+# Pairwise authenticated channels (DKG transport)
+#
+# Rows and ack values are point-to-point secrets.  Round 2 encrypted each
+# with one-shot ElGamal (2 G1 muls + a hash-to-G2 per value — O(n^3)
+# ladder work per era switch, THE era-switch wall at scale).  Round 3
+# derives one static-DH key per ordered pair ONCE (pk_b * sk_a == the
+# same point both ways) and seals values with an XOR keystream + HMAC
+# tag bound to (kind, proposer, sender, recipient), so per-value cost is
+# two SHA-256 calls.  Same confidentiality/integrity model as the
+# ElGamal construction (static keys, no forward secrecy — matching
+# threshold.PublicKey.encrypt); the reference's sync_key_gen equally
+# encrypts rows to static node keys.
+# ---------------------------------------------------------------------------
+
+
+def _rlc_scalars(seed: bytes, n: int) -> List[int]:
+    """Deterministic random 64-bit odd scalars for RLC checks.
+
+    SOUNDNESS CONTRACT: `seed` must bind EVERY byte of the data being
+    verified (Fiat-Shamir) — commitments AND the claimed values — or an
+    adversary who can predict the scalars solves one linear equation
+    and forges a passing combination.  Callers hash the full transcript
+    of what they are about to check."""
+    out = []
+    for i in range(n):
+        h = hashlib.sha256(seed + i.to_bytes(4, "big")).digest()
+        out.append(int.from_bytes(h[:8], "big") | 1)
+    return out
+
+
+def rlc_scalars(seed: bytes, n: int) -> List[int]:
+    """Public alias (shared with consensus-layer batch verifications)."""
+    return _rlc_scalars(seed, n)
+
+
+def g1_msm_or_fallback(points, scalars):
+    """Native Pippenger MSM when available, else the plain sum — the one
+    shared implementation for every RLC right-hand side."""
+    if native_bls.available():
+        return native_bls.g1_msm(points, scalars)
+    acc = infinity(FQ)
+    for pt, s in zip(points, scalars):
+        acc = add(acc, mul_sub(pt, s))
+    return acc
+
+
+def _seal(key: bytes, ctx: bytes, msg: bytes) -> bytes:
+    ks = b""
+    ctr = 0
+    while len(ks) < len(msg):
+        ks += hashlib.sha256(
+            key + b"|enc|" + ctx + ctr.to_bytes(4, "big")
+        ).digest()
+        ctr += 1
+    ct = bytes(a ^ b for a, b in zip(msg, ks))
+    tag = hmac_mod.new(key, b"|mac|" + ctx + ct, hashlib.sha256).digest()[:16]
+    return ct + tag
+
+
+def _open(key: bytes, ctx: bytes, blob: bytes) -> Optional[bytes]:
+    if len(blob) < 16:
+        return None
+    ct, tag = blob[:-16], blob[-16:]
+    want = hmac_mod.new(key, b"|mac|" + ctx + ct, hashlib.sha256).digest()[:16]
+    if not hmac_mod.compare_digest(want, tag):
+        return None
+    ks = b""
+    ctr = 0
+    while len(ks) < len(ct):
+        ks += hashlib.sha256(
+            key + b"|enc|" + ctx + ctr.to_bytes(4, "big")
+        ).digest()
+        ctr += 1
+    return bytes(a ^ b for a, b in zip(ct, ks))
 
 
 # ---------------------------------------------------------------------------
@@ -121,7 +215,14 @@ class BivarCommitment:
         return acc
 
     def row_commitment(self, x: int) -> List[tuple]:
-        """Commitment to the univariate row poly f(x, ·)."""
+        """Commitment to the univariate row poly f(x, ·).  Node-index
+        evaluation points take the native short-Horner fold (round 3);
+        x = 0 is simply the first coefficient row."""
+        if x == 0:
+            return list(self.points[0])
+        fast = _small_fold(self.points, x, 0)
+        if fast is not None:
+            return fast
         xs = [pow(x, j, R) for j in range(self.t + 1)]
         out = []
         for k in range(self.t + 1):
@@ -135,9 +236,11 @@ class BivarCommitment:
         """Commitment to the column poly f(·, y): col[j] = Σ_k P[j][k] y^k.
 
         Folding the y variable once turns every later evaluate(x, y)
-        into t+1 scalar muls instead of (t+1)^2 — the DKG ack-verify
-        hot path does one evaluate per committed ack (O(N^2) of them
-        per era switch)."""
+        into t+1 scalar muls instead of (t+1)^2 — and the fold itself is
+        the native short-Horner when y is a node index."""
+        fast = _small_fold(self.points, y, 1)
+        if fast is not None:
+            return fast
         ys = [pow(y, k, R) for k in range(self.t + 1)]
         out = []
         for j in range(self.t + 1):
@@ -206,6 +309,8 @@ class _ProposalState:
     acks: set = field(default_factory=set)
     # lazily-folded column commitment at y = our_idx+1 (ack verification)
     our_column: Optional[List[tuple]] = None
+    # round 3: ack values verify lazily in batch (SyncKeyGen._verify_values)
+    values_verified: bool = True
 
     def is_complete(self, threshold: int) -> bool:
         """OBJECTIVE completion: counts structurally-valid acks, which are
@@ -238,6 +343,7 @@ class SyncKeyGen(Generic[N]):
         pub_keys: Mapping[N, PublicKey],
         threshold: int,
         rng,
+        session: bytes = b"",
     ):
         self.our_id = our_id
         self.our_sk = our_sk
@@ -245,12 +351,52 @@ class SyncKeyGen(Generic[N]):
         self.pub_keys = dict(pub_keys)
         self.threshold = threshold
         self.rng = rng
+        # Channel-context nonce: the XOR keystream is deterministic from
+        # (static-DH key, ctx), so every DKG INSTANCE between the same
+        # long-lived node keys MUST use a distinct session tag or two
+        # eras' ciphertexts XOR to the XOR of two secret rows (two-time
+        # pad).  Callers pass the era/instance id; all participants in
+        # one DKG must agree on it.
+        self.session = bytes(session)
         if our_id not in self.pub_keys:
             raise ValueError("our_id must be among pub_keys")
         if len(self.node_ids) <= threshold:
             raise ValueError("need more than `threshold` nodes")
         self.our_idx = self.node_ids.index(our_id)
         self.parts: Dict[int, _ProposalState] = {}
+        self._chan_keys: Dict[int, bytes] = {}
+
+    # -- pairwise channels --------------------------------------------------
+
+    def _chan_key(self, idx: int) -> bytes:
+        """Static-DH channel key with node `idx` (symmetric both ways)."""
+        key = self._chan_keys.get(idx)
+        if key is None:
+            dh = mul_sub(
+                self.pub_keys[self.node_ids[idx]].point, self.our_sk.scalar
+            )
+            key = hashlib.sha256(b"HBTPU-DKG-CH" + g1_to_bytes(dh)).digest()
+            self._chan_keys[idx] = key
+        return key
+
+    def _row_ctx(self, proposer: int, recipient: int) -> bytes:
+        return (
+            b"R"
+            + self.session
+            + b"|"
+            + proposer.to_bytes(2, "big")
+            + recipient.to_bytes(2, "big")
+        )
+
+    def _val_ctx(self, proposer: int, sender: int, recipient: int) -> bytes:
+        return (
+            b"V"
+            + self.session
+            + b"|"
+            + proposer.to_bytes(2, "big")
+            + sender.to_bytes(2, "big")
+            + recipient.to_bytes(2, "big")
+        )
 
     # -- proposing ----------------------------------------------------------
 
@@ -261,7 +407,11 @@ class SyncKeyGen(Generic[N]):
         for m, nid in enumerate(self.node_ids):
             row = poly.row(m + 1)
             enc_rows.append(
-                self.pub_keys[nid].encrypt(codec.encode(row), self.rng).to_bytes()
+                _seal(
+                    self._chan_key(m),
+                    self._row_ctx(self.our_idx, m),
+                    codec.encode(row),
+                )
             )
         return Part(commit.to_bytes(), tuple(enc_rows))
 
@@ -300,20 +450,38 @@ class SyncKeyGen(Generic[N]):
             return PartOutcome(False, fault="wrong row count")
         row: Optional[List[int]] = None
         fault = None
-        try:
-            ct = Ciphertext.from_bytes(part.enc_rows[self.our_idx])
-            raw = self.our_sk.decrypt(ct, verify=False)
-            row = [int(c) % R for c in codec.decode(raw)]
-        except (ValueError, TypeError):
+        raw = _open(
+            self._chan_key(s),
+            self._row_ctx(s, self.our_idx),
+            bytes(part.enc_rows[self.our_idx]),
+        )
+        if raw is None:
             fault = "undecryptable row"
+        else:
+            try:
+                row = [int(c) % R for c in codec.decode(raw)]
+            except (ValueError, TypeError):
+                fault = "undecryptable row"
         if row is not None and len(row) != self.threshold + 1:
             row, fault = None, "wrong row degree"
         if row is not None:
+            # one RLC check instead of t+1 point equalities: with random
+            # 64-bit r_k, sum r_k row[k] * G == sum r_k expected[k] —
+            # a forged row passes with probability 2^-64
             expected = commit.row_commitment(self.our_idx + 1)
-            for k, coeff in enumerate(row):
-                if not eq(mul_sub(G1, coeff), expected[k]):
-                    row, fault = None, "row/commitment mismatch"
-                    break
+            # Fiat-Shamir: the seed hashes the FULL commitment and FULL
+            # row — a proposer fixing any prefix and solving for a later
+            # coefficient faces fresh scalars
+            seed = hashlib.sha256(
+                b"HBTPU-DKG-row"
+                + hashlib.sha256(part.commit_bytes).digest()
+                + hashlib.sha256(bytes(raw)).digest()
+            ).digest()
+            rs = _rlc_scalars(seed, len(row))
+            lhs_scalar = sum(r * c for r, c in zip(rs, row)) % R
+            rhs = g1_msm_or_fallback(expected, rs)
+            if not eq(mul_sub(G1, lhs_scalar), rhs):
+                row, fault = None, "row/commitment mismatch"
         state = _ProposalState(commit, row=row)
         self.parts[s] = state
         if row is None:
@@ -323,9 +491,11 @@ class SyncKeyGen(Generic[N]):
         for m, nid in enumerate(self.node_ids):
             val = poly_eval(row, m + 1)
             enc_values.append(
-                self.pub_keys[nid]
-                .encrypt(val.to_bytes(32, "big"), self.rng)
-                .to_bytes()
+                _seal(
+                    self._chan_key(m),
+                    self._val_ctx(s, self.our_idx, m),
+                    val.to_bytes(32, "big"),
+                )
             )
         return PartOutcome(True, ack=Ack(s, tuple(enc_values)))
 
@@ -335,7 +505,14 @@ class SyncKeyGen(Generic[N]):
         (our encrypted value decrypts and matches the commitment) are
         node-local and must not — the ack still counts toward the
         era-switch gate (see _ProposalState.is_complete), the sender is
-        faulted, and the bad value is simply not stored."""
+        faulted, and the bad value is simply not stored.
+
+        Round 3: the value/commitment check is DEFERRED and batched —
+        values are stored unverified and _verify_values() settles a
+        whole proposal's worth with one RLC equation over the folded
+        column when the values are consumed (generate()).  A mismatch
+        surfaces there as the value being dropped (the honest fast path
+        never re-evaluates per ack)."""
         m = self.node_index(sender_id)
         if ack.proposer_idx not in self.parts:
             return AckOutcome(False, fault="ack for unknown part")
@@ -345,24 +522,61 @@ class SyncKeyGen(Generic[N]):
         if len(ack.enc_values) != len(self.node_ids):
             return AckOutcome(False, fault="wrong value count")
         state.acks.add(m)
-        try:
-            ct = Ciphertext.from_bytes(ack.enc_values[self.our_idx])
-            raw = self.our_sk.decrypt(ct, verify=False)
-            val = int.from_bytes(raw, "big") % R
-        except (ValueError, TypeError):
+        raw = _open(
+            self._chan_key(m),
+            self._val_ctx(ack.proposer_idx, m, self.our_idx),
+            bytes(ack.enc_values[self.our_idx]),
+        )
+        if raw is None or len(raw) != 32:
             return AckOutcome(False, fault="undecryptable value")
-        # verify val == f_s(m+1, our_idx+1) against the commitment; the
-        # y = our_idx+1 column is folded once per proposal (t+1 muls per
-        # ack instead of (t+1)^2 — N^2 acks make this the era-switch wall)
+        state.values[m + 1] = int.from_bytes(raw, "big") % R
+        state.values_verified = False
+        return AckOutcome(True)
+
+    def _verify_values(self, state: "_ProposalState") -> None:
+        """Settle a proposal's stored ack values: one RLC check — with
+        random 64-bit r_m,
+          (sum_m r_m v_m) * G == sum_j col[j] * (sum_m r_m (m+1)^j)
+        over the y = our_idx+1 folded column — verifies every value at
+        once (forgery passes with probability 2^-64); on failure, the
+        per-value slow path drops exactly the bad entries."""
+        if getattr(state, "values_verified", True) or not state.values:
+            if not state.values:
+                state.values_verified = True
+            return
         if state.our_column is None:
             state.our_column = state.commitment.column_commitment(
                 self.our_idx + 1
             )
-        expected = g1_poly_eval(state.our_column, m + 1)
-        if not eq(mul_sub(G1, val), expected):
-            return AckOutcome(False, fault="value/commitment mismatch")
-        state.values[m + 1] = val
-        return AckOutcome(True)
+        items = sorted(state.values.items())  # (m+1, val)
+        # Fiat-Shamir: bind commitment AND every (index, value) pair —
+        # scalars predictable from indices alone would let colluding
+        # ackers send cancelling deviations that pass the batch check
+        h = hashlib.sha256()
+        h.update(b"HBTPU-DKG-ackval")
+        h.update(hashlib.sha256(state.commitment.to_bytes()).digest())
+        for mp, v in items:
+            h.update(mp.to_bytes(4, "big"))
+            h.update(int(v).to_bytes(32, "big"))
+        rs = _rlc_scalars(h.digest(), len(items))
+        lhs = sum(r * v for r, (_mp, v) in zip(rs, items)) % R
+        t1 = len(state.our_column)
+        ws = []
+        for j in range(t1):
+            w = 0
+            for r, (mp, _v) in zip(rs, items):
+                w += r * pow(mp, j, R)
+            ws.append(w % R)
+        rhs = g1_msm_or_fallback(state.our_column, ws)
+        if eq(mul_sub(G1, lhs), rhs):
+            state.values_verified = True
+            return
+        # slow path: drop exactly the mismatching values
+        for mp, val in items:
+            expected = g1_poly_eval(state.our_column, mp)
+            if not eq(mul_sub(G1, val), expected):
+                state.values.pop(mp, None)
+        state.values_verified = True
 
     # -- completion ---------------------------------------------------------
 
@@ -386,6 +600,7 @@ class SyncKeyGen(Generic[N]):
         for s, state in sorted(self.parts.items()):
             if not state.is_complete(t):
                 continue
+            self._verify_values(state)  # settle lazily-stored ack values
             row0 = state.commitment.row_commitment(0)
             commit_acc = [add(a, b) for a, b in zip(commit_acc, row0)]
             # interpolate our share slice from VERIFIED ack values only;

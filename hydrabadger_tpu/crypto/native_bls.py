@@ -266,6 +266,51 @@ def g1_mul_batch(points: Sequence, scalars: Sequence[int]) -> List:
     return [g1_mul_sub(p, s) for p, s in zip(points, scalars)]
 
 
+def g1_fold_pow(point_matrix: Sequence[Sequence], base: int, axis: int) -> List:
+    """Horner fold of a G1 point matrix by powers of a SMALL base along
+    `axis` (0: out[k] = sum_j P[j][k] base^j; 1: out[j] = sum_k P[j][k]
+    base^k) — the DKG row/column commitment evaluations, with short
+    double-and-add per step instead of full scalar muls."""
+    lib = _load()
+    rows = len(point_matrix)
+    cols = len(point_matrix[0])
+    if not 0 < base < (1 << 16):
+        raise ValueError("fold base must fit 16 bits")
+    raw = b"".join(
+        _g1_to_raw(p) for row in point_matrix for p in row
+    )
+    n_out = cols if axis == 0 else rows
+    out = _out(96 * n_out)
+    lib.bls_g1_fold_pow(
+        _buf(raw),
+        ctypes.c_int64(rows),
+        ctypes.c_int64(cols),
+        ctypes.c_uint64(base),
+        ctypes.c_int64(axis),
+        out,
+    )
+    return [
+        _g1_from_raw(bytes(out[96 * i : 96 * (i + 1)])) for i in range(n_out)
+    ]
+
+
+def g1_msm(points: Sequence, scalars: Sequence[int]):
+    """Pippenger multi-scalar multiplication: sum_i scalars[i] * points[i]."""
+    lib = _load()
+    n = len(points)
+    if n == 0:
+        from . import bls12_381 as bls
+
+        return bls.infinity(bls.FQ)
+    from . import bls12_381 as bls
+
+    raw = b"".join(_g1_to_raw(p) for p in points)
+    ks = b"".join((int(s) % bls.R).to_bytes(32, "big") for s in scalars)
+    out = _out(96)
+    lib.bls_g1_msm(_buf(raw), _buf(ks), ctypes.c_int64(n), out)
+    return _g1_from_raw(bytes(out))
+
+
 def g2_mul_batch(points: Sequence, scalars: Sequence[int]) -> List:
     """Batch of independent G2 scalar muls via the GLS ladder (subgroup)."""
     return [g2_mul_sub(p, s) for p, s in zip(points, scalars)]

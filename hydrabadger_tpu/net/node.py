@@ -58,6 +58,10 @@ KEYGEN_INBOX_CAP = 4096
 WIRE_RETRY_CAP = 10
 WIRE_RETRY_MAX_QUEUE = 4096
 WIRE_RETRY_TICK_S = 0.25
+# epoch liveness replay: if no batch commits for a tick, the node
+# re-broadcasts its current-epoch consensus frames (bounded ring)
+EPOCH_OUTBOX_MAX = 8192
+EPOCH_REPLAY_TICK_S = 1.0
 
 
 @dataclass
@@ -103,7 +107,14 @@ class KeyGenMachine:
     def start(self, our_uid, our_sk, pub_keys: Dict, rng) -> Part:
         self.n = len(pub_keys)
         threshold = self.n // 3
-        self.kg = SyncKeyGen(our_uid, our_sk, pub_keys, threshold, rng)
+        self.kg = SyncKeyGen(
+            our_uid,
+            our_sk,
+            pub_keys,
+            threshold,
+            rng,
+            session=str(self.instance_id).encode(),
+        )
         self.state = "generating"
         return self.kg.propose()
 
@@ -193,6 +204,15 @@ class Hydrabadger:
         self._tasks: List[asyncio.Task] = []
         self._share_recovery_task: Optional[asyncio.Task] = None
         self._wire_retry: deque = deque()  # (uid, msg, attempts)
+        # current-epoch outbound consensus frames, replayed by the
+        # liveness tick if the epoch stalls (closed-socket in-flight
+        # loss is invisible to sender-side salvage; every consensus
+        # handler is duplicate-tolerant, so replay is always safe)
+        self._epoch_outbox: deque = deque(maxlen=EPOCH_OUTBOX_MAX)
+        self._last_progress_batches = 0
+        # user/generator contributions awaiting an epoch whose proposal
+        # slot is still free (merged, in order, at the next opportunity)
+        self._pending_user: deque = deque(maxlen=4096)
         self._transcript_served: Dict[OutAddr, float] = {}  # rate limiting
         self._server: Optional[asyncio.base_events.Server] = None
         self._stopped = asyncio.Event()
@@ -290,6 +310,7 @@ class Hydrabadger:
         self._tasks.append(asyncio.create_task(self._handler_loop()))
         self._tasks.append(asyncio.create_task(self._keygen_retry_loop()))
         self._tasks.append(asyncio.create_task(self._wire_retry_loop()))
+        self._tasks.append(asyncio.create_task(self._epoch_replay_loop()))
         if gen_txns is not None:
             self._tasks.append(asyncio.create_task(self._generator_loop()))
         for remote in remotes or []:
@@ -454,8 +475,13 @@ class Hydrabadger:
         elif kind == "peer_disconnect":
             self._on_disconnect(item[1])
         elif kind == "api_propose":
-            if self.dhb is not None:
-                self._dispatch_step(self.dhb.propose(item[1], self.rng))
+            # Queue-and-merge, never fire-and-forget: DHB accepts ONE
+            # contribution per epoch, and the txn generator usually owns
+            # it — a direct propose() here would be silently swallowed
+            # by hb.has_input (a real starvation: user contributions on
+            # a generator-driven node could miss every epoch forever).
+            self._pending_user.append(bytes(item[1]))
+            self._flush_user_contributions()
         elif kind == "api_vote":
             if self.dhb is not None:
                 self.dhb.vote_for(item[1])
@@ -594,8 +620,12 @@ class Hydrabadger:
                 if now - last < 3.0:
                     return
                 self._transcript_served[peer.out_addr] = now
-                era, entries = self.dhb.last_transcript
-                peer.send(WireMessage("era_transcript", (era, tuple(entries))))
+                era, kg_era, entries = self.dhb.last_transcript
+                peer.send(
+                    WireMessage(
+                        "era_transcript", (era, kg_era, tuple(entries))
+                    )
+                )
         elif kind == "era_transcript":
             self._on_era_transcript(msg.payload)
         elif kind == "net_state_request":
@@ -701,8 +731,10 @@ class Hydrabadger:
         keep_new = peer.outgoing == (self.uid.bytes < uid.bytes)
         if keep_new:
             self.peers.remove(existing)
+            self._salvage_unsent(existing)
             existing.close()
             return True
+        self._salvage_unsent(peer)
         peer.close()
         self.peers.remove(peer)
         return False
@@ -911,11 +943,15 @@ class Hydrabadger:
             if tm.target.kind == "nodes":
                 for nid in tm.target.nodes:
                     uid = Uid(bytes(nid))
+                    self._epoch_outbox.append((uid, msg))
                     if not self.peers.wire_to(uid, msg):
                         self._queue_wire_retry(uid, msg)
             else:
                 # all / all_except: broadcast (observers need the traffic
-                # too — deliberately mirrors the reference, peer.rs:567)
+                # too — deliberately mirrors the reference, peer.rs:567).
+                # Loss of an in-flight broadcast (socket tie-breaks,
+                # reconnects) is covered by the epoch replay loop.
+                self._epoch_outbox.append((None, msg))
                 self.peers.wire_to_all(msg)
         for fault in step.fault_log:
             log.debug("fault: %s %s", str(fault.node_id)[:16], fault.kind)
@@ -926,11 +962,42 @@ class Hydrabadger:
             self.state = "validator"
             log.info("%s promoted to validator (era %d)", self.uid, self.dhb.era)
 
+    def _flush_user_contributions(self) -> None:
+        """Propose the merged pending contributions if the current epoch
+        is still open.  Payloads that decode as codec tuples (the txn
+        generator's shape) are flattened so transactions merge into one
+        tuple; opaque payloads ride as single elements."""
+        if (
+            not self._pending_user
+            or self.dhb is None
+            or not self.dhb.is_validator
+            or self.dhb.hb.has_input.get(self.dhb.hb.epoch)
+        ):
+            return
+        from ..utils import codec
+
+        elements: List[bytes] = []
+        for payload in self._pending_user:
+            try:
+                items = codec.decode(payload)
+                if isinstance(items, tuple):
+                    elements.extend(bytes(x) for x in items)
+                else:
+                    elements.append(payload)
+            except (ValueError, TypeError):
+                elements.append(payload)
+        self._pending_user.clear()
+        self._dispatch_step(
+            self.dhb.propose(codec.encode(tuple(elements)), self.rng)
+        )
+
     def _on_batch(self, batch: DhbBatch) -> None:
         if self.keygen_outbox and self.dhb.era != self.cfg.start_epoch:
             # past the bootstrap era: no straggler can use the transcript
             self.keygen_outbox = []
+        self._epoch_outbox.clear()  # the epoch committed; nothing to replay
         self.batches.append(batch)
+        self._flush_user_contributions()  # the next epoch just opened
         self.current_epoch = batch.epoch + 1
         self.batch_queue.put_nowait(batch)
         if batch.join_plan is not None:
@@ -1015,13 +1082,13 @@ class Hydrabadger:
         if d is None or d.netinfo.sk_share is not None:
             return
         try:
-            era, entries = payload
-            era = int(era)
+            era, kg_era, entries = payload
+            era, kg_era = int(era), int(kg_era)
         except (ValueError, TypeError):
             return
         if era != d.era:
             return
-        if d.install_share_from_transcript(entries):
+        if d.install_share_from_transcript(entries, kg_era):
             self.state = "validator"
             log.info(
                 "%s recovered era-%d secret share from committed transcript; "
@@ -1032,6 +1099,7 @@ class Hydrabadger:
 
     def _on_disconnect(self, peer: Peer) -> None:
         self.peers.remove(peer)
+        self._salvage_unsent(peer)
         peer.close()
         if (
             peer.uid is not None
@@ -1041,6 +1109,15 @@ class Hydrabadger:
         ):
             # vote the dead validator out (handler.rs:397-426)
             self.dhb.vote_to_remove(peer.uid.bytes)
+
+    def _salvage_unsent(self, peer: Peer) -> None:
+        """Re-park frames still queued on a dying connection into the
+        wire-retry queue (frames the pump never flushed would otherwise
+        vanish in a tie-break/disconnect — reliable-delivery hole)."""
+        if peer.uid is None:
+            return
+        for msg in peer.drain_unsent():
+            self._queue_wire_retry(peer.uid, msg)
 
     def _queue_wire_retry(self, uid: Uid, msg: WireMessage) -> None:
         """Park an undeliverable targeted frame for the retry tick
@@ -1073,6 +1150,35 @@ class Hydrabadger:
                         uid,
                         WIRE_RETRY_CAP,
                     )
+
+    async def _epoch_replay_loop(self) -> None:
+        """Liveness net for in-flight frame loss: a frame can die in a
+        closed socket's buffers on EITHER side of a duplicate-connection
+        tie-break or reconnect — invisible to sender-side salvage — and
+        HBBFT assumes reliable delivery, so one lost Conf or coin share
+        stalls the epoch forever.  If no batch commits for a whole tick,
+        re-broadcast the epoch's outbound frames; every consensus
+        handler (RBC/ABA/coin/decrypt) ignores duplicates, so replay is
+        unconditionally safe."""
+        while True:
+            await asyncio.sleep(EPOCH_REPLAY_TICK_S)
+            if self.dhb is None or not self._epoch_outbox:
+                continue
+            if len(self.batches) != self._last_progress_batches:
+                self._last_progress_batches = len(self.batches)
+                continue
+            frames = list(self._epoch_outbox)
+            log.debug(
+                "%s epoch stalled %.1fs: replaying %d frames",
+                self.uid,
+                EPOCH_REPLAY_TICK_S,
+                len(frames),
+            )
+            for target, msg in frames:
+                if target is None:
+                    self.peers.wire_to_all(msg)
+                elif not self.peers.wire_to(target, msg):
+                    self._queue_wire_retry(target, msg)
 
     async def _keygen_retry_loop(self) -> None:
         """Bootstrap liveness: gossip + re-broadcast until DKG completes.

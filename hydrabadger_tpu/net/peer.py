@@ -68,6 +68,21 @@ class Peer:
     def close(self) -> None:
         self.send_queue.put_nowait(None)
 
+    def drain_unsent(self) -> List[WireMessage]:
+        """Frames queued but not yet pumped onto the socket — salvaged by
+        the node's wire-retry queue when a connection dies (a duplicate-
+        connection tie-break mid-epoch must not lose RBC/ABA multicasts
+        the protocol assumes delivered)."""
+        out: List[WireMessage] = []
+        try:
+            while True:
+                msg = self.send_queue.get_nowait()
+                if msg is not None:
+                    out.append(msg)
+        except asyncio.QueueEmpty:
+            pass
+        return out
+
 
 class Peers:
     """Registry of live peers, addressable by address and node id."""

@@ -1,0 +1,125 @@
+"""ctypes bridge to the native ACS engine (native/acs_engine.cpp).
+
+Round 3's logic-tier dispatch core: one call runs a whole fast-tier
+epoch's Subset message storm — Bracha RBC (RS + Merkle + split-root
+re-encode checks), MMR binary agreement with the hash coin, and the
+subset sweep — for all N nodes in C++ (~1 us/message vs ~120 us through
+the Python router/handler chain).  The Python consensus cores remain
+the semantic oracle (tests/test_native_acs.py pins subset equality and
+the DHB batch flow); DHB-layer semantics (votes, eras, DKG) consume
+the agreed subset in Python, mirroring the reference's native-hbbft
+layering (/root/reference/src/hydrabadger/handler.rs:698-715).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("HYDRABADGER_NO_NATIVE_ACS"):
+        return None
+    path = os.path.join(_NATIVE_DIR, "libacs.so")
+    if not os.path.exists(path):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-s", "libacs.so"],
+                check=False,
+                timeout=180,
+                capture_output=True,
+            )
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.acs_run.restype = ctypes.c_int64
+    lib.acs_run.argtypes = [
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_char_p,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class AcsStats:
+    __slots__ = ("delivered", "faults", "extra_rounds")
+
+    def __init__(self, delivered: int, faults: int, extra_rounds: int):
+        self.delivered = delivered
+        self.faults = faults
+        self.extra_rounds = extra_rounds
+
+
+def acs_run(
+    payloads: Sequence[bytes],
+    f: int,
+    sid: bytes,
+    shuffle: bool = True,
+    seed: int = 0,
+) -> tuple[List[bool], AcsStats]:
+    """Run one N-node fast-tier ACS epoch natively.
+
+    payloads[i] is proposer i's contribution.  Returns (mask, stats)
+    where mask[i] says whether slot i entered the agreed subset (the
+    engine verifies all N nodes agreed and that accepted payloads
+    round-tripped bit-exactly; any internal failure raises).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native ACS engine unavailable")
+    n = len(payloads)
+    bufs = [ctypes.create_string_buffer(p, len(p)) for p in payloads]
+    ptrs = (ctypes.POINTER(ctypes.c_uint8) * n)(
+        *[ctypes.cast(b, ctypes.POINTER(ctypes.c_uint8)) for b in bufs]
+    )
+    lens = (ctypes.c_int32 * n)(*[len(p) for p in payloads])
+    mask = (ctypes.c_uint8 * n)()
+    stats = (ctypes.c_uint64 * 3)()
+    rc = lib.acs_run(
+        n,
+        f,
+        bytes(sid),
+        len(sid),
+        ctypes.cast(ptrs, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))),
+        lens,
+        1 if shuffle else 0,
+        seed & 0xFFFFFFFFFFFFFFFF,
+        0,
+        mask,
+        stats,
+    )
+    if rc != 0:
+        raise RuntimeError(f"native ACS failed (rc={rc})")
+    return (
+        [bool(v) for v in mask],
+        AcsStats(int(stats[0]), int(stats[1]), int(stats[2])),
+    )

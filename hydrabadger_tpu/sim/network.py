@@ -48,6 +48,10 @@ class SimConfig:
     # router quiescence budget per epoch; None = auto (the message
     # complexity of an epoch is O(N^3): N broadcast instances x O(N^2))
     max_messages_per_epoch: Optional[int] = None
+    # native C++ ACS dispatch core (sim/native_acs.py): None = auto (use
+    # it when built and the epoch is eligible: fast crypto tier, hash
+    # coin, no adversary); True = require; False = always Python cores
+    native_acs: Optional[bool] = None
 
 
 @dataclass
@@ -180,10 +184,99 @@ class SimNetwork:
         pad = max(0, self.cfg.txn_bytes - 4)
         return prefix + bytes(self.rng.getrandbits(8) for _ in range(pad))
 
+    def _native_eligible(self) -> bool:
+        cfg = self.cfg
+        if cfg.native_acs is False:
+            return False
+        ok = (
+            cfg.adversary is None
+            and not cfg.encrypt
+            and cfg.coin_mode == "hash"
+            and cfg.protocol in ("qhb", "dhb")
+        )
+        if cfg.native_acs is True:
+            if not ok:
+                raise ValueError(
+                    "native_acs=True requires fast tier, hash coin, "
+                    "no adversary"
+                )
+            from . import native_acs
+
+            if not native_acs.available():
+                raise RuntimeError("native ACS engine not built")
+            return True
+        if not ok:
+            return False
+        from . import native_acs
+
+        return native_acs.available()
+
+    def _run_epoch_native(self) -> None:
+        """One epoch through the C++ ACS world: gather contributions,
+        agree natively, apply the batch to every core's DHB/QHB pipeline
+        (votes, era switches, queue pruning all run in Python exactly as
+        on the message plane)."""
+        from . import native_acs
+
+        cfg = self.cfg
+        if cfg.protocol == "qhb":
+            for nid in self.ids:
+                for _ in range(cfg.txns_per_node_per_epoch):
+                    self.nodes[nid].push_transaction(self._gen_txn())
+            validators = list(self.ids)
+            payloads = [
+                self.nodes[nid].external_contribution(self.rng)
+                for nid in validators
+            ]
+            hb = self.nodes[validators[0]].hb
+        else:
+            validators = [
+                nid for nid in self.ids if self.nodes[nid].is_validator
+            ]
+            payloads = []
+            for nid in validators:
+                user = b"".join(
+                    self._gen_txn()
+                    for _ in range(cfg.txns_per_node_per_epoch)
+                )
+                payloads.append(
+                    self.nodes[nid].external_contribution(user)
+                )
+            hb = self.nodes[validators[0]].hb
+        netinfo = hb.netinfo
+        assert list(netinfo.node_ids) == validators, "validator order drift"
+        sid = hb.session_id + b"/" + str(hb.epoch).encode()
+        mask, stats = native_acs.acs_run(
+            payloads,
+            netinfo.num_faulty,
+            sid,
+            shuffle=cfg.shuffle,
+            seed=cfg.seed * 1_000_003 + hb.epoch,
+        )
+        contributions = {
+            nid: payloads[i] for i, nid in enumerate(validators) if mask[i]
+        }
+        self.router.delivered += stats.delivered
+        for nid in self.ids:
+            step = self.nodes[nid].apply_external_batch(dict(contributions))
+            # era switches may emit follow-up traffic (none on the fast
+            # tier today, but keep the plane closed if they ever do)
+            if step.messages:
+                self.router.dispatch_step(nid, step)
+        if self.router.queue:
+            self.router.run(
+                self.cfg.max_messages_per_epoch
+                or max(1_000_000, 60 * self.cfg.n_nodes**3)
+            )
+
     def run_epoch(self) -> None:
         """Generate workload, propose everywhere, run to quiescence."""
         t0 = time.perf_counter()
         cfg = self.cfg
+        if self._native_eligible():
+            self._run_epoch_native()
+            self.epoch_durations.append(time.perf_counter() - t0)
+            return
         if cfg.protocol == "qhb":
             for nid in self.ids:
                 for _ in range(cfg.txns_per_node_per_epoch):

@@ -1,0 +1,1068 @@
+// Native ACS (Asynchronous Common Subset) world — the logic-tier
+// dispatch core for round 3 (VERDICT item 2).
+//
+// The Python consensus cores (consensus/broadcast.py, binary_agreement.py,
+// subset.py) are the semantic oracle: this file runs the SAME protocol —
+// Bracha RBC over systematic Reed-Solomon shards bound by SHA-256 Merkle
+// proofs, Mostefaoui-Moumen-Raynal binary agreement with a hash coin, and
+// the subset wiring (N-f acceptance sweep) — for all N nodes of one
+// epoch inside a single C++ message loop.  The interpreter dispatch that
+// capped BASELINE config 5 (~120 us/message through router.py and the
+// handler chain) becomes ~100 ns/message here; DHB-layer semantics
+// (votes, eras, DKG) stay in Python and consume the agreed subset, the
+// same layering the reference gets from the native hbbft crate
+// (/root/reference/Cargo.toml:41-55, src/hydrabadger/handler.rs:698-715).
+//
+// Fidelity notes (kept deliberately identical to the Python cores):
+//   - RBC does the split-root re-encode check before accepting a payload
+//     (broadcast.py:159-186), with real RS decode + re-encode + Merkle
+//     rebuild work per (node, proposer).
+//   - ABA rounds gate exactly like binary_agreement.py (stale-round
+//     drops, future-round buffering in that round's state, _replay_round
+//     on advance, Term shortcut at f+1, MAX_ROUNDS fault bound).
+//   - The coin is the fast-tier hash coin:
+//     SHA256("ABA-COIN" + sid + be32(round))[0] & 1 with
+//     sid = sid_base + "/" + str(proposer_index) — byte-identical to
+//     binary_agreement.py:207-213, so round counts match the oracle.
+//   - Multicasts are self-handled synchronously (types.py Step.broadcast
+//     semantics); the router delivers FIFO or seeded-random (router.py
+//     shuffle mode, swap-pop uniform pick).
+//
+// Exposed via a C ABI consumed by hydrabadger_tpu/sim/native_acs.py
+// (ctypes); build: `make -C native` -> libacs.so.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <array>
+#include <deque>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+#if defined(__x86_64__)
+// SHA-NI one-block compression (the hot 90% of the echo-validation
+// path).  Standard ABEF/CDGH register schedule; selected at runtime via
+// __builtin_cpu_supports("sha") with the portable C fallback below.
+__attribute__((target("sha,sse4.1")))
+void sha256_block_ni(uint32_t h[8], const uint8_t* p) {
+  static const uint32_t K[64] = {
+      0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+      0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+      0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+      0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+      0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+      0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+      0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+      0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+      0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+      0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+      0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+  const __m128i BSWAP =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i tmp = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&h[0]));
+  __m128i st1 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&h[4]));
+  tmp = _mm_shuffle_epi32(tmp, 0xB1);
+  st1 = _mm_shuffle_epi32(st1, 0x1B);
+  __m128i st0 = _mm_alignr_epi8(tmp, st1, 8);
+  st1 = _mm_blend_epi16(st1, tmp, 0xF0);
+  const __m128i save0 = st0, save1 = st1;
+
+  __m128i msg[4];
+  for (int i = 0; i < 4; i++)
+    msg[i] = _mm_shuffle_epi8(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(p + 16 * i)), BSWAP);
+
+  __m128i m;
+  for (int r = 0; r < 16; r++) {
+    // rounds 4r .. 4r+3
+    m = _mm_add_epi32(
+        msg[r & 3],
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(&K[4 * r])));
+    st1 = _mm_sha256rnds2_epu32(st1, st0, m);
+    if (r >= 3 && r < 15) {
+      // message schedule for block r+1
+      __m128i t = _mm_alignr_epi8(msg[r & 3], msg[(r + 3) & 3], 4);
+      msg[(r + 1) & 3] = _mm_sha256msg2_epu32(
+          _mm_add_epi32(
+              _mm_sha256msg1_epu32(msg[(r + 1) & 3], msg[(r + 2) & 3]), t),
+          msg[r & 3]);
+    }
+    m = _mm_shuffle_epi32(m, 0x0E);
+    st0 = _mm_sha256rnds2_epu32(st0, st1, m);
+  }
+  st0 = _mm_add_epi32(st0, save0);
+  st1 = _mm_add_epi32(st1, save1);
+  tmp = _mm_shuffle_epi32(st0, 0x1B);
+  st1 = _mm_shuffle_epi32(st1, 0xB1);
+  st0 = _mm_blend_epi16(tmp, st1, 0xF0);
+  st1 = _mm_alignr_epi8(st1, tmp, 8);
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&h[0]), st0);
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&h[4]), st1);
+}
+
+bool have_sha_ni() {
+  static const bool ok = __builtin_cpu_supports("sha");
+  return ok;
+}
+#endif
+
+// ---------------------------------------------------------------------------
+// SHA-256 (compact, self-contained)
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  uint32_t h[8];
+  uint8_t buf[64];
+  uint64_t len = 0;
+  size_t fill = 0;
+
+  static constexpr uint32_t K[64] = {
+      0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+      0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+      0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+      0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+      0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+      0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+      0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+      0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+      0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+      0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+      0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+  Sha256() { reset(); }
+
+  void reset() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(h));
+    len = 0;
+    fill = 0;
+  }
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void block(const uint8_t* p) {
+#if defined(__x86_64__)
+    if (have_sha_ni()) {
+      sha256_block_ni(h, p);
+      return;
+    }
+#endif
+    block_scalar(p);
+  }
+
+  void block_scalar(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + mj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    len += n;
+    while (n) {
+      size_t take = 64 - fill;
+      if (take > n) take = n;
+      memcpy(buf + fill, p, take);
+      fill += take;
+      p += take;
+      n -= take;
+      if (fill == 64) {
+        block(buf);
+        fill = 0;
+      }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (fill != 56) update(&z, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; i++) lb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+constexpr uint32_t Sha256::K[64];
+
+using Hash = std::array<uint8_t, 32>;
+using Bytes = std::vector<uint8_t>;
+
+Hash sha256(const uint8_t* p, size_t n) {
+  Sha256 s;
+  s.update(p, n);
+  Hash out;
+  s.final(out.data());
+  return out;
+}
+
+Hash leaf_hash(const Bytes& v) {
+  Sha256 s;
+  uint8_t t = 0x00;
+  s.update(&t, 1);
+  s.update(v.data(), v.size());
+  Hash out;
+  s.final(out.data());
+  return out;
+}
+
+Hash node_hash(const Hash& l, const Hash& r) {
+  Sha256 s;
+  uint8_t t = 0x01;
+  s.update(&t, 1);
+  s.update(l.data(), 32);
+  s.update(r.data(), 32);
+  Hash out;
+  s.final(out.data());
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) + systematic Reed-Solomon (mirrors crypto/gf256.py + rs.py)
+// ---------------------------------------------------------------------------
+
+struct GF {
+  uint8_t exp[512];
+  uint8_t log[256];
+  GF() {
+    // generator 3 over 0x11b (gf256.py's field); any primitive pair works
+    // for self-consistency — the engine only ever decodes its own shards.
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = uint8_t(x);
+      log[x] = uint8_t(i);
+      x <<= 1;
+      if (x & 0x100) x ^= 0x11b;
+      x ^= exp[i];  // x = 3 * old (multiply by 2 then add 1x)
+      x &= 0xff;
+      // note: above computes x_{i+1} = 2*x_i ^ x_i = 3*x_i in GF
+    }
+    exp[255] = exp[0];
+    for (int i = 256; i < 512; i++) exp[i] = exp[i - 255];
+    log[0] = 0;
+  }
+  uint8_t mul(uint8_t a, uint8_t b) const {
+    if (!a || !b) return 0;
+    return exp[log[a] + log[b]];
+  }
+  uint8_t div(uint8_t a, uint8_t b) const {
+    if (!a) return 0;
+    return exp[(log[a] + 255 - log[b]) % 255];
+  }
+  uint8_t pow_el(uint8_t a, int e) const {
+    if (e == 0) return 1;
+    if (!a) return 0;
+    return exp[(log[a] * (e % 255)) % 255];
+  }
+};
+const GF gf;
+
+// Gauss-Jordan inverse of an k x k GF matrix; returns false if singular.
+bool gf_mat_inv(std::vector<uint8_t>& m, int k) {
+  std::vector<uint8_t> inv(k * k, 0);
+  for (int i = 0; i < k; i++) inv[i * k + i] = 1;
+  for (int col = 0; col < k; col++) {
+    int piv = -1;
+    for (int r = col; r < k; r++)
+      if (m[r * k + col]) { piv = r; break; }
+    if (piv < 0) return false;
+    if (piv != col) {
+      for (int c = 0; c < k; c++) {
+        std::swap(m[piv * k + c], m[col * k + c]);
+        std::swap(inv[piv * k + c], inv[col * k + c]);
+      }
+    }
+    uint8_t d = m[col * k + col];
+    for (int c = 0; c < k; c++) {
+      m[col * k + c] = gf.div(m[col * k + c], d);
+      inv[col * k + c] = gf.div(inv[col * k + c], d);
+    }
+    for (int r = 0; r < k; r++) {
+      if (r == col) continue;
+      uint8_t factor = m[r * k + col];
+      if (!factor) continue;
+      for (int c = 0; c < k; c++) {
+        m[r * k + c] ^= gf.mul(factor, m[col * k + c]);
+        inv[r * k + c] ^= gf.mul(factor, inv[col * k + c]);
+      }
+    }
+  }
+  m.swap(inv);
+  return true;
+}
+
+// systematic encode matrix [n, k]: vandermonde (rows = powers of alpha^i)
+// normalised so the top k x k block is the identity (rs.py:33-46)
+struct RsCodec {
+  int k, m, n;
+  std::vector<uint8_t> mat;  // [n, k]
+  RsCodec(int k_, int m_) : k(k_), m(m_), n(k_ + m_) {
+    std::vector<uint8_t> vm(n * k);
+    for (int i = 0; i < n; i++) {
+      uint8_t xi = gf.exp[i % 255];  // distinct nonzero points
+      for (int j = 0; j < k; j++) vm[i * k + j] = gf.pow_el(xi, j);
+    }
+    std::vector<uint8_t> top(vm.begin(), vm.begin() + k * k);
+    if (!gf_mat_inv(top, k)) { /* vandermonde top is invertible */ }
+    mat.resize(n * k);
+    for (int i = 0; i < n; i++)
+      for (int j = 0; j < k; j++) {
+        uint8_t acc = 0;
+        for (int t = 0; t < k; t++)
+          acc ^= gf.mul(vm[i * k + t], top[t * k + j]);
+        mat[i * k + j] = acc;
+      }
+  }
+
+  // payload -> n shards (4-byte BE length prefix, zero pad; rs.py:83-96)
+  std::vector<Bytes> encode_bytes(const Bytes& payload) const {
+    Bytes prefixed(4 + payload.size());
+    uint32_t L = uint32_t(payload.size());
+    prefixed[0] = uint8_t(L >> 24); prefixed[1] = uint8_t(L >> 16);
+    prefixed[2] = uint8_t(L >> 8); prefixed[3] = uint8_t(L);
+    memcpy(prefixed.data() + 4, payload.data(), payload.size());
+    size_t shard_len = (prefixed.size() + k - 1) / k;
+    prefixed.resize(shard_len * k, 0);
+    std::vector<Bytes> shards(n, Bytes(shard_len));
+    for (int i = 0; i < k; i++)
+      memcpy(shards[i].data(), prefixed.data() + i * shard_len, shard_len);
+    for (int i = k; i < n; i++) {
+      for (size_t c = 0; c < shard_len; c++) {
+        uint8_t acc = 0;
+        for (int j = 0; j < k; j++)
+          acc ^= gf.mul(mat[i * k + j], prefixed[j * shard_len + c]);
+        shards[i][c] = acc;
+      }
+    }
+    return shards;
+  }
+
+  // >= k shards (nullptr = missing) -> payload; false on failure
+  bool reconstruct_data(const std::vector<const Bytes*>& slots,
+                        Bytes& out) const {
+    std::vector<int> present;
+    size_t shard_len = 0;
+    for (int i = 0; i < n; i++)
+      if (slots[i]) {
+        present.push_back(i);
+        shard_len = slots[i]->size();
+      }
+    if ((int)present.size() < k) return false;
+    std::vector<Bytes> data(k);
+    bool systematic = true;
+    for (int i = 0; i < k; i++)
+      if (!slots[i]) { systematic = false; break; }
+    if (systematic) {
+      for (int i = 0; i < k; i++) data[i] = *slots[i];
+    } else {
+      std::vector<int> rows(present.begin(), present.begin() + k);
+      std::vector<uint8_t> sub(k * k);
+      for (int r = 0; r < k; r++)
+        memcpy(sub.data() + r * k, mat.data() + rows[r] * k, k);
+      if (!gf_mat_inv(sub, k)) return false;
+      for (int i = 0; i < k; i++) {
+        data[i].assign(shard_len, 0);
+        for (size_t c = 0; c < shard_len; c++) {
+          uint8_t acc = 0;
+          for (int r = 0; r < k; r++)
+            acc ^= gf.mul(sub[i * k + r], (*slots[rows[r]])[c]);
+          data[i][c] = acc;
+        }
+      }
+    }
+    Bytes joined;
+    joined.reserve(k * shard_len);
+    for (int i = 0; i < k; i++)
+      joined.insert(joined.end(), data[i].begin(), data[i].end());
+    if (joined.size() < 4) return false;
+    uint32_t L = (uint32_t(joined[0]) << 24) | (uint32_t(joined[1]) << 16) |
+                 (uint32_t(joined[2]) << 8) | uint32_t(joined[3]);
+    if (L > joined.size() - 4) return false;
+    out.assign(joined.begin() + 4, joined.begin() + 4 + L);
+    return true;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Merkle tree + proofs (mirrors consensus/merkle.py)
+// ---------------------------------------------------------------------------
+
+struct Proof {
+  const Bytes* value;   // shard bytes (owned by the tree/world)
+  int index;
+  std::vector<Hash> path;  // sibling hashes, leaf level first
+  Hash root;
+
+  bool validate(int n_leaves) const {
+    if (index < 0 || index >= n_leaves) return false;
+    Hash acc = leaf_hash(*value);
+    int idx = index;
+    for (const Hash& sib : path) {
+      acc = (idx % 2 == 0) ? node_hash(acc, sib) : node_hash(sib, acc);
+      idx /= 2;
+    }
+    return acc == root;
+  }
+};
+
+struct MerkleTree {
+  std::vector<Bytes> leaves;
+  std::vector<std::vector<Hash>> levels;
+
+  explicit MerkleTree(std::vector<Bytes> lv) : leaves(std::move(lv)) {
+    levels.emplace_back();
+    for (const Bytes& l : leaves) levels.back().push_back(leaf_hash(l));
+    while (levels.back().size() > 1) {
+      const auto& cur = levels.back();
+      std::vector<Hash> nxt;
+      for (size_t i = 0; i < cur.size(); i += 2) {
+        const Hash& l = cur[i];
+        const Hash& r = (i + 1 < cur.size()) ? cur[i + 1] : cur[i];
+        nxt.push_back(node_hash(l, r));
+      }
+      levels.push_back(std::move(nxt));
+    }
+  }
+
+  const Hash& root() const { return levels.back()[0]; }
+
+  Proof proof(int index) const {
+    Proof p;
+    p.value = &leaves[index];
+    p.index = index;
+    p.root = root();
+    int idx = index;
+    for (size_t lvl = 0; lvl + 1 < levels.size(); lvl++) {
+      size_t sib = (idx % 2 == 0) ? idx + 1 : idx - 1;
+      if (sib >= levels[lvl].size()) sib = idx;
+      p.path.push_back(levels[lvl][sib]);
+      idx /= 2;
+    }
+    return p;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Messages and world
+// ---------------------------------------------------------------------------
+
+enum Kind : uint8_t { VALUE, ECHO, READY, BVAL, AUX, CONF, TERM };
+
+struct Msg {
+  uint8_t kind;
+  uint8_t round = 0;  // ABA round
+  uint8_t bits = 0;   // bval/aux/term: value; conf: bit0 = has 0, bit1 = has 1
+  uint16_t prop = 0;  // proposer index
+  const Proof* proof = nullptr;  // value/echo
+  int32_t root_id = -1;          // ready
+};
+
+struct QMsg {
+  uint16_t from, to;
+  Msg m;
+};
+
+struct splitmix64 {
+  uint64_t s;
+  explicit splitmix64(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, bound)
+  uint64_t below(uint64_t bound) { return next() % bound; }
+};
+
+struct RbcState {
+  bool value_received = false, echo_sent = false, ready_sent = false,
+       decided = false;
+  bool has_payload = false;
+  Bytes payload;
+  std::vector<const Proof*> echos;   // [n], nullptr = none
+  std::vector<int32_t> readys;       // [n], -1 = none
+  std::map<int32_t, int> echo_count, ready_count;  // by root id
+};
+
+struct AbaRound {
+  uint8_t sent_bval = 0;  // bit0 = sent 0, bit1 = sent 1
+  std::vector<uint8_t> recv_bval[2];
+  int bval_count[2] = {0, 0};
+  uint8_t bin_values = 0;
+  bool aux_sent = false, conf_sent = false, coin_invoked = false;
+  std::vector<int8_t> recv_aux;   // [n] -1/0/1
+  int aux_count[2] = {0, 0};
+  std::vector<int8_t> recv_conf;  // [n] -1 or bits (1, 2, 3)
+  int conf_count[4] = {0, 0, 0, 0};
+  int8_t conf_values = -1;  // bits
+};
+
+struct AbaState {
+  int round = 0;
+  int8_t estimate = -1;
+  int8_t decision = -1;
+  bool terminated = false, term_sent = false;
+  std::vector<AbaRound> rounds;
+  std::vector<uint8_t> recv_term[2];
+  int term_count[2] = {0, 0};
+};
+
+struct NodeState {
+  std::vector<RbcState> rbc;   // [n] per proposer
+  std::vector<AbaState> aba;   // [n] per proposer
+  std::vector<uint8_t> bc_result;  // [n] 1 if payload captured
+  std::vector<int8_t> ba_result;   // [n] -1 undecided / 0 / 1
+  int ba_decided_count = 0;
+  int accepted = 0;
+  bool voted_zero = false;
+  bool decided = false;
+};
+
+struct World {
+  int n, f;
+  std::string sid_base;
+  std::vector<Bytes> payloads;
+  RsCodec codec;
+  bool shuffle;
+  splitmix64 rng;
+  uint64_t max_msgs;
+
+  std::vector<NodeState> nodes;
+  std::vector<std::vector<Proof>> proofs;  // [proposer][leaf]
+  std::vector<QMsg> queue;                 // swap-pop for shuffle; index-FIFO
+  size_t fifo_head = 0;
+  std::vector<Hash> roots;                 // interned
+  std::map<Hash, int32_t> root_ids;
+  uint64_t delivered = 0, faults = 0, rounds_total = 0;
+
+  World(int n_, int f_, std::string sid, std::vector<Bytes> pls, bool shuf,
+        uint64_t seed, uint64_t maxm)
+      : n(n_), f(f_), sid_base(std::move(sid)), payloads(std::move(pls)),
+        codec(n_ - 2 * f_, 2 * f_), shuffle(shuf), rng(seed), max_msgs(maxm) {
+    nodes.resize(n);
+    for (auto& ns : nodes) {
+      ns.rbc.resize(n);
+      ns.aba.resize(n);
+      for (auto& r : ns.rbc) {
+        r.echos.assign(n, nullptr);
+        r.readys.assign(n, -1);
+      }
+      ns.bc_result.assign(n, 0);
+      ns.ba_result.assign(n, -1);
+    }
+    proofs.resize(n);
+  }
+
+  int32_t intern_root(const Hash& h) {
+    auto it = root_ids.find(h);
+    if (it != root_ids.end()) return it->second;
+    int32_t id = int32_t(roots.size());
+    roots.push_back(h);
+    root_ids.emplace(h, id);
+    return id;
+  }
+
+  void send(int from, int to, const Msg& m) { queue.push_back({uint16_t(from), uint16_t(to), m}); }
+
+  // multicast to all others; self-handled synchronously by the caller
+  void multicast(int from, const Msg& m) {
+    for (int to = 0; to < n; to++)
+      if (to != from) send(from, to, m);
+  }
+
+  AbaRound& aba_round(AbaState& a, int rnd) {
+    while ((int)a.rounds.size() <= rnd) {
+      a.rounds.emplace_back();
+      auto& r = a.rounds.back();
+      r.recv_bval[0].assign(n, 0);
+      r.recv_bval[1].assign(n, 0);
+      r.recv_aux.assign(n, -1);
+      r.recv_conf.assign(n, -1);
+    }
+    return a.rounds[rnd];
+  }
+
+  // -- RBC ------------------------------------------------------------------
+
+  void rbc_broadcast(int me, int prop) {
+    RbcState& r = nodes[me].rbc[prop];
+    if (r.value_received) return;
+    auto shards = codec.encode_bytes(payloads[prop]);
+    MerkleTree tree(std::move(shards));
+    proofs[prop].clear();
+    proofs[prop].reserve(n);
+    for (int i = 0; i < n; i++) proofs[prop].push_back(tree.proof(i));
+    // Proof.value points into tree.leaves, which dies with `tree`: move
+    // the leaves into world storage with STABLE element addresses (a
+    // deque never relocates existing elements) and re-point the proofs.
+    leaf_store.emplace_back(std::move(tree.leaves));
+    for (int i = 0; i < n; i++) proofs[prop][i].value = &leaf_store.back()[i];
+    r.value_received = true;
+    Msg m;
+    m.kind = VALUE;
+    m.prop = uint16_t(prop);
+    for (int to = 0; to < n; to++) {
+      if (to == me) continue;
+      Msg mv = m;
+      mv.proof = &proofs[prop][to];
+      send(me, to, mv);
+    }
+    rbc_send_echo(me, prop, &proofs[prop][me]);
+  }
+
+  std::deque<std::vector<Bytes>> leaf_store;
+
+  void rbc_send_echo(int me, int prop, const Proof* proof) {
+    RbcState& r = nodes[me].rbc[prop];
+    if (r.echo_sent) return;
+    r.echo_sent = true;
+    Msg m;
+    m.kind = ECHO;
+    m.prop = uint16_t(prop);
+    m.proof = proof;
+    multicast(me, m);
+    rbc_handle_echo(me, me, prop, proof);
+  }
+
+  void rbc_handle_value(int me, int from, int prop, const Proof* proof) {
+    if (from != prop) { faults++; return; }
+    RbcState& r = nodes[me].rbc[prop];
+    if (r.value_received) return;
+    if (proof->index != me || !proof->validate(n)) { faults++; return; }
+    r.value_received = true;
+    rbc_send_echo(me, prop, proof);
+  }
+
+  void rbc_send_ready(int me, int prop, int32_t root_id) {
+    RbcState& r = nodes[me].rbc[prop];
+    if (r.ready_sent) return;
+    r.ready_sent = true;
+    Msg m;
+    m.kind = READY;
+    m.prop = uint16_t(prop);
+    m.root_id = root_id;
+    multicast(me, m);
+    rbc_handle_ready(me, me, prop, root_id);
+  }
+
+  void rbc_handle_echo(int me, int from, int prop, const Proof* proof) {
+    RbcState& r = nodes[me].rbc[prop];
+    if (r.echos[from]) {
+      if (r.echos[from] != proof) {
+        // honest world: identical proof objects; conflicting = fault
+        faults++;
+      }
+      return;
+    }
+    if (proof->index != from || !proof->validate(n)) { faults++; return; }
+    r.echos[from] = proof;
+    int32_t rid = intern_root(proof->root);
+    int ec = ++r.echo_count[rid];
+    if (ec >= n - f && !r.ready_sent) rbc_send_ready(me, prop, rid);
+    auto rc = r.ready_count.find(rid);
+    if (rc != r.ready_count.end() && rc->second >= 2 * f + 1 &&
+        ec >= codec.k)
+      rbc_try_decode(me, prop, rid);
+  }
+
+  void rbc_handle_ready(int me, int from, int prop, int32_t root_id) {
+    RbcState& r = nodes[me].rbc[prop];
+    if (r.readys[from] != -1) {
+      if (r.readys[from] != root_id) faults++;
+      return;
+    }
+    r.readys[from] = root_id;
+    int rc = ++r.ready_count[root_id];
+    if (rc >= f + 1 && !r.ready_sent) rbc_send_ready(me, prop, root_id);
+    auto ec = r.echo_count.find(root_id);
+    if (rc >= 2 * f + 1 && ec != r.echo_count.end() && ec->second >= codec.k)
+      rbc_try_decode(me, prop, root_id);
+  }
+
+  void rbc_try_decode(int me, int prop, int32_t root_id) {
+    RbcState& r = nodes[me].rbc[prop];
+    if (r.decided) return;
+    std::vector<const Bytes*> slots(n, nullptr);
+    for (int s = 0; s < n; s++) {
+      const Proof* p = r.echos[s];
+      if (p && root_ids.at(p->root) == root_id) slots[p->index] = p->value;
+    }
+    Bytes payload;
+    if (!codec.reconstruct_data(slots, payload)) {
+      faults++;
+      return;
+    }
+    // split-root re-encode check (broadcast.py:174-181): rebuild the
+    // full coding + tree and compare roots
+    auto full = codec.encode_bytes(payload);
+    MerkleTree tree(std::move(full));
+    r.decided = true;
+    if (!(intern_root(tree.root()) == root_id)) {
+      faults++;
+      return;
+    }
+    r.has_payload = true;
+    r.payload = std::move(payload);
+    subset_progress_one(me, prop);
+  }
+
+  // -- ABA ------------------------------------------------------------------
+
+  bool hash_coin(int prop, int rnd) {
+    // SHA256("ABA-COIN" + sid_base + "/" + str(prop) + be32(rnd))[0] & 1
+    std::string doc = "ABA-COIN" + sid_base + "/" + std::to_string(prop);
+    uint8_t be[4] = {uint8_t(rnd >> 24), uint8_t(rnd >> 16), uint8_t(rnd >> 8),
+                     uint8_t(rnd)};
+    Sha256 s;
+    s.update(reinterpret_cast<const uint8_t*>(doc.data()), doc.size());
+    s.update(be, 4);
+    Hash out;
+    s.final(out.data());
+    return out[0] & 1;
+  }
+
+  void aba_propose(int me, int prop, bool value) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.estimate != -1 || a.terminated) return;
+    a.estimate = value ? 1 : 0;
+    aba_send_bval(me, prop, a.round, value);
+  }
+
+  void aba_send_bval(int me, int prop, int rnd, bool b) {
+    AbaState& a = nodes[me].aba[prop];
+    AbaRound& r = aba_round(a, rnd);
+    if (r.sent_bval & (1 << b)) return;
+    r.sent_bval |= (1 << b);
+    Msg m;
+    m.kind = BVAL;
+    m.prop = uint16_t(prop);
+    m.round = uint8_t(rnd);
+    m.bits = b;
+    multicast(me, m);
+    aba_handle_bval(me, me, prop, rnd, b);
+  }
+
+  void aba_handle_bval(int me, int from, int prop, int rnd, bool b) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.terminated || rnd < a.round) return;
+    AbaRound& r = aba_round(a, rnd);
+    if (r.recv_bval[b][from]) return;
+    r.recv_bval[b][from] = 1;
+    int count = ++r.bval_count[b];
+    if (count == f + 1 && !(r.sent_bval & (1 << b)))
+      aba_send_bval(me, prop, rnd, b);
+    // re-fetch: aba_send_bval may have re-entered and mutated
+    AbaRound& r2 = aba_round(a, rnd);
+    if (count == 2 * f + 1) {
+      bool first = r2.bin_values == 0;
+      r2.bin_values |= (1 << b);
+      if (first && rnd == a.round && !r2.aux_sent) {
+        r2.aux_sent = true;
+        Msg m;
+        m.kind = AUX;
+        m.prop = uint16_t(prop);
+        m.round = uint8_t(rnd);
+        m.bits = b;
+        multicast(me, m);
+        aba_handle_aux(me, me, prop, rnd, b);
+      } else if (rnd == a.round) {
+        aba_check_aux(me, prop, rnd);
+      }
+    }
+  }
+
+  void aba_handle_aux(int me, int from, int prop, int rnd, bool b) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.terminated || rnd < a.round) return;
+    AbaRound& r = aba_round(a, rnd);
+    if (r.recv_aux[from] != -1) return;
+    r.recv_aux[from] = b ? 1 : 0;
+    r.aux_count[b]++;
+    if (rnd != a.round) return;
+    aba_check_aux(me, prop, rnd);
+  }
+
+  void aba_check_aux(int me, int prop, int rnd) {
+    AbaState& a = nodes[me].aba[prop];
+    AbaRound& r = aba_round(a, rnd);
+    if (r.conf_sent || r.bin_values == 0 || rnd != a.round) return;
+    int good = 0;
+    uint8_t vals = 0;
+    for (int v = 0; v < 2; v++)
+      if (r.bin_values & (1 << v)) {
+        good += r.aux_count[v];
+        if (r.aux_count[v]) vals |= (1 << v);
+      }
+    if (good < n - f) return;
+    r.conf_sent = true;
+    Msg m;
+    m.kind = CONF;
+    m.prop = uint16_t(prop);
+    m.round = uint8_t(rnd);
+    m.bits = vals;
+    multicast(me, m);
+    aba_handle_conf(me, me, prop, rnd, vals);
+  }
+
+  void aba_handle_conf(int me, int from, int prop, int rnd, uint8_t bits) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.terminated || rnd < a.round) return;
+    AbaRound& r = aba_round(a, rnd);
+    if (r.recv_conf[from] != -1) return;
+    r.recv_conf[from] = int8_t(bits);
+    r.conf_count[bits & 3]++;
+    if (rnd != a.round) return;
+    aba_check_conf(me, prop, rnd);
+  }
+
+  void aba_check_conf(int me, int prop, int rnd) {
+    AbaState& a = nodes[me].aba[prop];
+    AbaRound& r = aba_round(a, rnd);
+    if (r.coin_invoked || rnd != a.round) return;
+    int good = 0;
+    uint8_t uni = 0;
+    for (uint8_t c = 1; c <= 3; c++) {
+      if ((c & r.bin_values) == c) {  // subset of bin_values, non-empty
+        good += r.conf_count[c];
+        if (r.conf_count[c]) uni |= c;
+      }
+    }
+    if (good < n - f) return;
+    r.conf_values = int8_t(uni);
+    r.coin_invoked = true;
+    bool coin = hash_coin(prop, rnd);
+    aba_on_coin(me, prop, rnd, coin);
+  }
+
+  void aba_on_coin(int me, int prop, int rnd, bool coin) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.terminated || rnd != a.round) return;
+    AbaRound& r = aba_round(a, rnd);
+    uint8_t vals = uint8_t(r.conf_values);
+    if (vals == uint8_t(1 << coin)) {
+      aba_decide(me, prop, coin);
+      return;
+    }
+    if (vals == 1 || vals == 2) {
+      a.estimate = (vals == 2) ? 1 : 0;
+    } else {
+      a.estimate = coin ? 1 : 0;
+    }
+    a.round = rnd + 1;
+    rounds_total++;
+    if (a.round >= 200) {  // MAX_ROUNDS — unreachable in the honest world
+      a.terminated = true;
+      faults++;
+      subset_progress_one(me, prop);
+      return;
+    }
+    aba_send_bval(me, prop, a.round, a.estimate == 1);
+    aba_replay_round(me, prop, a.round);
+  }
+
+  void aba_replay_round(int me, int prop, int rnd) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.terminated || rnd != a.round) return;
+    AbaRound& r = aba_round(a, rnd);
+    if (r.bin_values != 0 && !r.aux_sent) {
+      bool b = (r.bin_values & 2) ? true : false;  // "next(iter(...))"
+      // mirror python set iteration: {False} -> False, {True} -> True,
+      // {False, True} iterates False first
+      if (r.bin_values & 1) b = false;
+      r.aux_sent = true;
+      Msg m;
+      m.kind = AUX;
+      m.prop = uint16_t(prop);
+      m.round = uint8_t(rnd);
+      m.bits = b;
+      multicast(me, m);
+      aba_handle_aux(me, me, prop, rnd, b);
+    }
+    aba_check_aux(me, prop, rnd);
+    AbaRound& r2 = aba_round(a, rnd);
+    if (r2.conf_sent) aba_check_conf(me, prop, rnd);
+  }
+
+  void aba_decide(int me, int prop, bool b) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.decision != -1) return;
+    a.decision = b ? 1 : 0;
+    a.terminated = true;
+    if (!a.term_sent) {
+      a.term_sent = true;
+      Msg m;
+      m.kind = TERM;
+      m.prop = uint16_t(prop);
+      m.round = uint8_t(a.round);
+      m.bits = b;
+      multicast(me, m);
+      aba_handle_term(me, me, prop, b);
+    }
+    subset_progress_one(me, prop);
+  }
+
+  void aba_handle_term(int me, int from, int prop, bool b) {
+    AbaState& a = nodes[me].aba[prop];
+    if (a.recv_term[b].empty()) a.recv_term[b].assign(n, 0);
+    if (a.recv_term[b][from]) return;
+    a.recv_term[b][from] = 1;
+    a.term_count[b]++;
+    if (a.term_count[b] >= f + 1 && a.decision == -1) aba_decide(me, prop, b);
+  }
+
+  // -- Subset wiring (subset.py) -------------------------------------------
+
+  void subset_progress_one(int me, int prop) {
+    NodeState& ns = nodes[me];
+    RbcState& r = ns.rbc[prop];
+    if (!ns.bc_result[prop] && r.decided && r.has_payload) {
+      ns.bc_result[prop] = 1;
+      AbaState& a = ns.aba[prop];
+      if (a.estimate == -1 && !a.terminated) aba_propose(me, prop, true);
+    }
+    AbaState& a = ns.aba[prop];
+    if (ns.ba_result[prop] == -1 && a.terminated) {
+      ns.ba_result[prop] = a.decision == 1 ? 1 : 0;
+      ns.ba_decided_count++;
+      if (a.decision == 1) ns.accepted++;
+    }
+    subset_global(me);
+  }
+
+  void subset_global(int me) {
+    NodeState& ns = nodes[me];
+    if (ns.accepted >= n - f && !ns.voted_zero) {
+      ns.voted_zero = true;
+      for (int p = 0; p < n; p++) {
+        AbaState& a = ns.aba[p];
+        if (a.estimate == -1 && !a.terminated) aba_propose(me, p, false);
+      }
+    }
+    if (!ns.decided && ns.ba_decided_count == n) {
+      for (int p = 0; p < n; p++)
+        if (ns.ba_result[p] == 1 && !ns.bc_result[p]) return;  // pending
+      ns.decided = true;
+    }
+  }
+
+  // -- delivery -------------------------------------------------------------
+
+  void handle(int to, int from, const Msg& m) {
+    switch (m.kind) {
+      case VALUE: rbc_handle_value(to, from, m.prop, m.proof); subset_progress_one(to, m.prop); break;
+      case ECHO: rbc_handle_echo(to, from, m.prop, m.proof); subset_progress_one(to, m.prop); break;
+      case READY: rbc_handle_ready(to, from, m.prop, m.root_id); subset_progress_one(to, m.prop); break;
+      case BVAL: aba_handle_bval(to, from, m.prop, m.round, m.bits & 1); subset_progress_one(to, m.prop); break;
+      case AUX: aba_handle_aux(to, from, m.prop, m.round, m.bits & 1); subset_progress_one(to, m.prop); break;
+      case CONF: aba_handle_conf(to, from, m.prop, m.round, m.bits); subset_progress_one(to, m.prop); break;
+      case TERM: aba_handle_term(to, from, m.prop, m.bits & 1); subset_progress_one(to, m.prop); break;
+    }
+  }
+
+  // returns 0 on success
+  int run() {
+    for (int me = 0; me < n; me++) rbc_broadcast(me, me);
+    while (true) {
+      if (queue.empty() || (!shuffle && fifo_head >= queue.size())) break;
+      QMsg qm;
+      if (shuffle) {
+        size_t idx = rng.below(queue.size());
+        qm = queue[idx];
+        queue[idx] = queue.back();
+        queue.pop_back();
+      } else {
+        qm = queue[fifo_head++];
+        if (fifo_head > 4u * 1024 * 1024 && fifo_head * 2 > queue.size()) {
+          queue.erase(queue.begin(), queue.begin() + fifo_head);
+          fifo_head = 0;
+        }
+      }
+      delivered++;
+      if (delivered > max_msgs) return -2;  // livelock guard
+      handle(qm.to, qm.from, qm.m);
+    }
+    for (int me = 0; me < n; me++)
+      if (!nodes[me].decided) return -3;  // no termination
+    // agreement check across nodes
+    for (int me = 1; me < n; me++)
+      for (int p = 0; p < n; p++)
+        if (nodes[me].ba_result[p] != nodes[0].ba_result[p]) return -4;
+    // payload integrity: accepted slots must equal the proposed payloads
+    for (int p = 0; p < n; p++)
+      if (nodes[0].ba_result[p] == 1) {
+        for (int me = 0; me < n; me++) {
+          const RbcState& r = nodes[me].rbc[p];
+          if (!r.has_payload || r.payload != payloads[p]) return -5;
+        }
+      }
+    return 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Runs one fast-tier ACS epoch for n honest nodes.  Returns 0 on
+// success (out_mask[p] = 1 iff proposer p's slot is in the agreed
+// subset; out_stats = {delivered, faults, extra_aba_rounds}); negative
+// on internal failure.
+int64_t acs_run(int32_t n, int32_t f, const uint8_t* sid, int32_t sid_len,
+                const uint8_t* const* payloads, const int32_t* payload_lens,
+                int32_t shuffle, uint64_t seed, uint64_t max_msgs,
+                uint8_t* out_mask, uint64_t* out_stats) {
+  if (n <= 0 || n > 255 || f < 0 || n - 2 * f <= 0) return -1;
+  std::vector<Bytes> pls(n);
+  for (int i = 0; i < n; i++)
+    pls[i].assign(payloads[i], payloads[i] + payload_lens[i]);
+  World w(n, f, std::string(reinterpret_cast<const char*>(sid), sid_len),
+          std::move(pls), shuffle != 0, seed,
+          max_msgs ? max_msgs : (60ull * n * n * n + 1000000ull));
+  int rc = w.run();
+  if (rc != 0) return rc;
+  for (int p = 0; p < n; p++) out_mask[p] = uint8_t(w.nodes[0].ba_result[p]);
+  if (out_stats) {
+    out_stats[0] = w.delivered;
+    out_stats[1] = w.faults;
+    out_stats[2] = w.rounds_total;
+  }
+  return 0;
+}
+}

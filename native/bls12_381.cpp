@@ -31,10 +31,12 @@
 //    secret scalars only transit g1_mul/g2_mul for local signing.
 #include <cstdint>
 #include <cstring>
+#include <vector>
 #include "bls381_constants.h"
 
 typedef unsigned __int128 u128;
 typedef int64_t i64;
+typedef uint32_t u32;
 
 // ---------------------------------------------------------------------------
 // SHA-256 (FIPS 180-4), compact host implementation for hash_to_g2
@@ -1558,6 +1560,83 @@ void bls_g1_mul(const u8* pt96, const u8* k_be, i64 klen, u8* out96) {
     JPoint<Fp> r;
     j_mul_be(r, g1_to_j(g1_load(pt96)), k_be, klen);
     g1_store(out96, g1_from_j(r));
+}
+
+// Polynomial fold of a G1 point matrix along one axis by powers of a
+// small base (Horner): axis=0 -> out[k] = sum_j P[j][k] base^j (row
+// commitment at x=base), axis=1 -> out[j] = sum_k P[j][k] base^k
+// (column commitment at y=base).  base is a node index + 1 (< 2^16), so
+// each Horner step is a short double-and-add — the DKG commitment
+// evaluations that were the era-switch wall (crypto/dkg.py) drop from
+// (t+1)^2 full scalar muls to (t+1)^2 short ones.
+void bls_g1_fold_pow(const u8* pts96, i64 rows, i64 cols, u64 base,
+                     i64 axis, u8* out96s) {
+    const i64 outer = axis == 0 ? cols : rows;
+    const i64 inner = axis == 0 ? rows : cols;
+    u8 kb[2] = {u8(base >> 8), u8(base & 0xff)};
+    for (i64 o = 0; o < outer; o++) {
+        JPoint<Fp> acc = j_inf<Fp>();
+        for (i64 t = inner - 1; t >= 0; t--) {
+            // P[j][k] with (j, k) = axis == 0 ? (t, o) : (o, t)
+            const u8* p = axis == 0 ? pts96 + 96 * (t * cols + o)
+                                    : pts96 + 96 * (o * cols + t);
+            if (t != inner - 1) {
+                JPoint<Fp> scaled;
+                j_mul_be(scaled, acc, kb, 2);
+                acc = scaled;
+            }
+            j_add(acc, acc, g1_to_j(g1_load(p)));
+        }
+        g1_store(out96s + 96 * o, g1_from_j(acc));
+    }
+}
+
+// Pippenger multi-scalar multiplication: out = sum_i k_i * P_i over G1.
+// points: n x 96-byte big-endian affine (zeros = infinity); scalars:
+// n x 32-byte big-endian.  The round-3 DKG verification core — one MSM
+// replaces the per-ack commitment folds that were the era-switch wall
+// (crypto/dkg.py handle_ack), cutting O(n^2 t) full scalar muls per node
+// to one bucketed pass over the committed points.
+void bls_g1_msm(const u8* pts96, const u8* ks32, i64 n, u8* out96) {
+    if (n <= 0) {
+        memset(out96, 0, 96);
+        return;
+    }
+    int c;  // window bits, balancing n adds/window vs 2^c bucket folds
+    if (n < 64) c = 5;
+    else if (n < 1024) c = 8;
+    else if (n < 16384) c = 11;
+    else c = 14;
+    const int windows = (255 + c - 1) / c;
+    std::vector<JPoint<Fp>> pts(n);
+    for (i64 i = 0; i < n; i++) pts[i] = g1_to_j(g1_load(pts96 + 96 * i));
+    const u32 nbuckets = 1u << c;
+    std::vector<JPoint<Fp>> buckets(nbuckets);
+    JPoint<Fp> total = j_inf<Fp>();
+    for (int w = windows - 1; w >= 0; w--) {
+        for (int d = 0; d < c; d++) j_dbl(total, total);
+        for (u32 b = 1; b < nbuckets; b++) buckets[b] = j_inf<Fp>();
+        const int lo_bit = w * c;
+        for (i64 i = 0; i < n; i++) {
+            const u8* k = ks32 + 32 * i;
+            u32 digit = 0;
+            for (int b = 0; b < c; b++) {
+                int bit = lo_bit + b;
+                if (bit >= 256) break;
+                int byte = 31 - bit / 8;
+                if (k[byte] >> (bit % 8) & 1) digit |= 1u << b;
+            }
+            if (digit) j_add(buckets[digit], buckets[digit], pts[i]);
+        }
+        // sum_b b * bucket[b] via suffix sums
+        JPoint<Fp> running = j_inf<Fp>(), acc = j_inf<Fp>();
+        for (u32 b = nbuckets - 1; b >= 1; b--) {
+            j_add(running, running, buckets[b]);
+            j_add(acc, acc, running);
+        }
+        j_add(total, total, acc);
+    }
+    g1_store(out96, g1_from_j(total));
 }
 
 void bls_g2_add(const u8* a192, const u8* b192, u8* out192) {

@@ -208,10 +208,10 @@ def test_stranded_joiner_recovers_share_from_transcript():
     assert not obs.is_validator  # member of the set, but share-less
 
     # every validator stashed the same committed transcript at the switch
-    era, entries = dhbs[ids[0]].last_transcript
+    era, kg_era, entries = dhbs[ids[0]].last_transcript
     assert era == plan.era
-    era2, entries2 = dhbs[ids[1]].last_transcript
-    assert entries2 == entries
+    era2, kg_era2, entries2 = dhbs[ids[1]].last_transcript
+    assert entries2 == entries and kg_era2 == kg_era
 
     # a forged transcript (rows re-encrypted under a different dealer) is
     # rejected: the derived pk_set cannot match the plan's
@@ -222,11 +222,11 @@ def test_stranded_joiner_recovers_share_from_transcript():
     forger = SKG(joiner, joiner_sk, forger_keys, 1, forged_rng)
     fake_part = forger.propose()
     forged = [(joiner, ("part", fake_part.commit_bytes, tuple(fake_part.enc_rows)))]
-    assert not obs.install_share_from_transcript(forged)
+    assert not obs.install_share_from_transcript(forged, kg_era)
     assert obs.netinfo.sk_share is None
 
     # the genuine transcript installs the share and promotes
-    assert obs.install_share_from_transcript(entries)
+    assert obs.install_share_from_transcript(entries, kg_era)
     assert obs.netinfo.sk_share is not None
     assert obs.is_validator
 

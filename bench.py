@@ -1,18 +1,18 @@
-"""Benchmarks for every BASELINE.json config (1-6).
+"""Benchmarks for every BASELINE.json config (1-8).
 
-The default (config 6) is the north-star metric itself: HoneyBadger
-epochs/sec for a 64-node network with 256 B contributions, 1024
-concurrent instances — the fault-free fast-path epoch (RS encode ->
-disseminate -> reconstruct -> totality check; >99% of the reference's
-per-epoch compute, see sim/tensor.py) running device-resident, vs the
-byte-identical per-instance CPU loop (the call pattern every node in
-the reference runs around reed-solomon-erasure inside hbbft::broadcast).
-Config 3 is the bandwidth-bound variant of the same comparison
-(raw RS shard throughput at 256-byte shards).
+The default (config 6) prints the north-star metric — HoneyBadger
+fast-path epochs/sec, 64 nodes x 1024 instances, device-resident — WITH
+the full-crypto (config 8) number beside it in the same JSON line, so
+the honest variant always rides the headline (VERDICT r2 item 4).  The
+fast path is >99% of the reference's per-epoch compute on the
+UNENCRYPTED tier only; config 8 includes the BLS wall.  `--all` runs
+every config and writes BENCH_all.json.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-where vs_baseline is the TPU/CPU ratio (north-star target: >= 50x).
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+Every vs_baseline states its denominator in the metric name or the
+config docstring (TPU vs CPU engine, TPU vs native host, native ACS
+vs Python dispatch).
 """
 from __future__ import annotations
 
@@ -111,12 +111,14 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
     """BASELINE.json config 4: 64-node sim, `epochs` concurrent epochs,
     batched BLS12-381 ThresholdDecrypt share generation on TPU.
 
-    The CPU baseline is the per-share pure-Python G1 scalar mult the
-    reference's threshold_crypto performs node-by-node inside
-    hbbft::threshold_decrypt; measured on a sample and extrapolated
-    (the loop is steady-state).  The TPU path runs every
-    (epoch x node) share as one lane of a single GLV dual-table
-    windowed kernel.
+    The baseline (vs_baseline's denominator) is the NATIVE C++ host
+    engine's per-share G1 GLV ladder (crypto/native_bls — bls.multiply
+    dispatches there when the library is built; round 1's pure-Python
+    loop was ~45x slower still), the speed the reference's
+    threshold_crypto stack runs this loop one share at a time; measured
+    on a sample and extrapolated (the loop is steady-state).  The TPU
+    path runs every (epoch x node) share as one lane of the fq_T Pallas
+    GLV ladder.
     """
     import random
 
@@ -138,6 +140,9 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
     # CPU baseline: sampled per-share scalar mults
     from hydrabadger_tpu.crypto import bls12_381 as bls
 
+    from hydrabadger_tpu.crypto import native_bls
+
+    host_tier = "native" if native_bls.available() else "python"
     sample = 8
     t0 = time.perf_counter()
     for i in range(sample):
@@ -157,7 +162,7 @@ def _bls_threshold_decrypt_config4(epochs: int) -> dict:
     return {
         "metric": (
             f"bls_tdec_shares_per_sec_64node_{epochs}epoch_"
-            f"{jax.default_backend()}"
+            f"{jax.default_backend()}_vs_{host_tier}_host"
         ),
         "value": round(accel_sps, 1),
         "unit": "shares/s",
@@ -218,14 +223,19 @@ def _sim16_config2(epochs: int) -> dict:
     the CPU anchor the TPU configs are measured against."""
     from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
 
+    from hydrabadger_tpu.sim import native_acs
+
     net = SimNetwork(SimConfig(n_nodes=16, protocol="qhb", seed=0))
     m = net.run(epochs)
     assert m.agreement_ok
+    tier = "native_acs" if (
+        net._native_eligible() and native_acs.available()
+    ) else "cpu"
     return {
-        "metric": "sim_epochs_per_sec_16node_cpu",
+        "metric": f"sim_epochs_per_sec_16node_{tier}",
         "value": round(m.epochs_per_sec, 3),
         "unit": "epochs/s",
-        "vs_baseline": 1.0,  # the CPU baseline itself
+        "vs_baseline": 1.0,  # the host-dispatch baseline itself
     }
 
 
@@ -234,15 +244,31 @@ def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
     4096-txn epochs.
 
     A removal vote is injected at epoch 1; the run asserts the change
-    commits, the era switches, and the surviving validators keep
-    committing identical batches.  The full 128-node logic tier is a
-    soak run (an epoch is O(N^3) Python messages and the era-switch DKG
-    is O(N^2) acks of pure-Python G1 ops), so the default scales to 8
-    nodes; `vs_baseline` reports the TPU/CPU
-    shard-throughput ratio of this topology's Reed-Solomon geometry at
-    4096 concurrent instances — the part of config 5 the TPU executes.
+    commits, the era switches (a full trustless DKG among the
+    survivors), and the surviving validators keep committing identical
+    batches.  Round 3 runs the epoch message storm through the native
+    C++ ACS engine and the era-switch crypto through the batched DKG
+    (pairwise channels + RLC/MSM verification), so the full 64-node
+    topology — and 128 with `--nodes 128` — completes in-window.
+
+    `vs_baseline` is the DISPATCH ratio: messages/s through the native
+    ACS world divided by messages/s through the Python consensus cores,
+    both measured on THIS run's own topology class (the Python side
+    calibrated at 16 nodes — a full Python epoch at the target size
+    would take hours, which is precisely the wall being measured).
     """
+    import time as _time
+
     from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    # Python-core dispatch calibration (per-message cost at 16 nodes)
+    cal = SimNetwork(
+        SimConfig(n_nodes=16, protocol="dhb", txns_per_node_per_epoch=4,
+                  txn_bytes=2, seed=7, native_acs=False)
+    )
+    t0 = _time.perf_counter()
+    cal.run(2)
+    py_per_msg = (_time.perf_counter() - t0) / max(1, cal.router.delivered)
 
     txns_per_node = max(1, 4096 // n_nodes)
     net = SimNetwork(
@@ -277,23 +303,27 @@ def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
     m = net.run(max(1, epochs - len(net.epoch_durations)))
     assert m.agreement_ok
 
-    # the TPU leg: this topology's broadcast shard geometry, 4096
-    # instances, steady-state vs the per-instance CPU loop
-    f = (n_nodes - 1) // 3
-    k, p_sh = n_nodes - 2 * f, 2 * f
-    rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, (4096, k, 256)).astype(np.uint8)
-    tpu_sps = _scan_encode_sps(k, p_sh, data, reps=20)
-    cpu_sps = _loop_encode_sps(k, p_sh, data)
+    # dispatch ratio from STEADY epochs only (total wall is dominated by
+    # the era-switch DKG crypto, which is not a dispatch measurement)
+    d0, w0 = net.router.delivered, net.total_wall_s
+    m = net.run(2)
+    native_msgs_per_sec = (net.router.delivered - d0) / max(
+        1e-9, net.total_wall_s - w0
+    )
+    python_msgs_per_sec = 1.0 / py_per_msg if py_per_msg else 0.0
 
     return {
         "metric": (
             f"dhb_churn_epochs_per_sec_{n_nodes}node_"
-            f"{txns_per_node * n_nodes}txn"
+            f"{txns_per_node * n_nodes}txn_native_acs"
         ),
         "value": round(m.epochs_per_sec, 4),
         "unit": "epochs/s",
-        "vs_baseline": round(tpu_sps / cpu_sps, 2),
+        # denominator: Python-core consensus dispatch (msgs/s, 16-node
+        # calibration); numerator: this run's native-ACS dispatch
+        "vs_baseline": round(native_msgs_per_sec / python_msgs_per_sec, 2)
+        if python_msgs_per_sec
+        else 0.0,
     }
 
 
@@ -496,9 +526,17 @@ def main(argv=None) -> int:
     p.add_argument(
         "--nodes",
         type=int,
-        default=8,
-        help="config 5 topology size (128 = full BASELINE soak, hours; "
-        "an epoch is O(N^3) Python messages on the logic tier)",
+        default=64,
+        help="config 5 topology size; 64 (default) and 128 both complete "
+        "in-window on the native ACS engine (round 3) — the era-switch "
+        "DKG is the long pole at 128 (~10 min)",
+    )
+    p.add_argument(
+        "--all",
+        action="store_true",
+        help="run every config and write the full artifact to "
+        "BENCH_all.json (stdout still prints ONE line: the config-6 "
+        "headline with config 8 reported alongside)",
     )
     args = p.parse_args(argv)
     if args.epochs is not None and args.epochs < 1:
@@ -507,11 +545,39 @@ def main(argv=None) -> int:
     def epochs_or(default: int) -> int:
         return default if args.epochs is None else args.epochs
 
+    if args.all:
+        results = {}
+        results["config1_tcp_full_crypto"] = _tcp_testnet_config1(2)
+        results["config2_sim16_cpu"] = _sim16_config2(20)
+        results["config4_bls_tdec"] = _bls_threshold_decrypt_config4(1024)
+        results["config5_dhb_churn"] = _dhb_churn_config5(args.nodes, 8)
+        results["config6_fastpath"] = _tensor_epochs_config6(1024, 50)
+        results["config7_verified_shares"] = _verified_shares_config7(1024)
+        results["config8_full_crypto"] = _full_crypto_epochs_config8(64, 4)
+        with open("BENCH_all.json", "w") as fh:
+            json.dump(results, fh, indent=1)
+        head = dict(results["config6_fastpath"])
+        head["full_crypto_epochs_per_sec"] = results["config8_full_crypto"][
+            "value"
+        ]
+        head["full_crypto_vs_native_host"] = results["config8_full_crypto"][
+            "vs_baseline"
+        ]
+        print(json.dumps(head))
+        return 0
+
     if args.config == 1:
         print(json.dumps(_tcp_testnet_config1(epochs_or(2))))
         return 0
     if args.config == 6:
-        print(json.dumps(_tensor_epochs_config6(1024, epochs_or(50))))
+        # the honest headline (VERDICT r2 item 4): the fast-path number
+        # with the full-crypto (config 8) number beside it, so the
+        # driver artifact always carries both
+        head = _tensor_epochs_config6(1024, epochs_or(50))
+        full = _full_crypto_epochs_config8(64, 2)
+        head["full_crypto_epochs_per_sec"] = full["value"]
+        head["full_crypto_vs_native_host"] = full["vs_baseline"]
+        print(json.dumps(head))
         return 0
     if args.config == 2:
         print(json.dumps(_sim16_config2(epochs_or(20))))

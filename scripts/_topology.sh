@@ -1,9 +1,12 @@
 # Shared by run-node / debug-node / profile-node / memprof-node.
 # Computes the reference's local test topology (run-node:19-25): node <id>
-# listens on port 3000+id and dials its 2 lower neighbors.  Honors
-# HYDRABADGER_FAST (default 1: hash coin, no threshold encryption, no
-# frame signatures — the full tier is pairing-bound in the pure-Python
-# BLS engine; set HYDRABADGER_FAST=0 for the full crypto tier).
+# listens on port 3000+id and dials its 2 lower neighbors.
+# Default is the FULL crypto tier — threshold-encrypted contributions,
+# threshold common coin, share verification, BLS-signed frames — the
+# reference's only mode (lib.rs:429-447 has no unsigned path); the
+# native BLS engine sustains it since round 2.  Set HYDRABADGER_FAST=1
+# for the keyless fast tier (hash coin, no encryption, unsigned frames)
+# when iterating on protocol logic.
 if [[ $# -lt 1 ]]; then
     echo "usage: $0 <node-id> [extra peer-node args...]" >&2
     exit 1
@@ -16,7 +19,7 @@ for ((i = ID - 2; i < ID; i++)); do
     ((i >= 0)) && REMOTES+=(-r "127.0.0.1:$((3000 + i))")
 done
 EXTRA=()
-if [[ "${HYDRABADGER_FAST:-1}" == "1" ]]; then
+if [[ "${HYDRABADGER_FAST:-0}" == "1" ]]; then
     EXTRA+=(--fast-crypto)
 fi
 NODE_ARGS=(-b "127.0.0.1:${PORT}" "${REMOTES[@]}" "${EXTRA[@]}" "$@")

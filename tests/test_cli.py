@@ -53,3 +53,18 @@ def test_mine_flag(capsys):
     assert main(["--mine"]) == 0
     out = capsys.readouterr().out
     assert "#0" in out and "nonce=" in out
+
+
+def test_topology_defaults_to_full_crypto_tier():
+    """Round 3 flips the launcher default: scripts/_topology.sh adds
+    --fast-crypto only when HYDRABADGER_FAST=1, so `run-node 0..3` runs
+    the reference-parity full tier (signed frames, threshold coin,
+    encryption — lib.rs:429-447 has no unsigned mode) by default."""
+    import pathlib
+
+    sh = (
+        pathlib.Path(__file__).resolve().parents[1]
+        / "scripts"
+        / "_topology.sh"
+    ).read_text()
+    assert 'HYDRABADGER_FAST:-0' in sh, "full tier must be the default"

@@ -46,3 +46,24 @@ def test_broadcast_round_rejects_bad_geometry():
     m = pmesh.make_mesh(8)
     with pytest.raises(ValueError):
         pmesh.broadcast_round_sharded(rand((7, 5, 8), 0), 5, 2, m)
+
+
+@pytest.mark.slow
+def test_full_crypto_epoch_sharded_across_mesh():
+    """Round 3 (VERDICT item 3): the BLS plane on the mesh — a full-
+    crypto epoch's share ladders, combines, and combine==U*master
+    verdict run instance-sharded over the 8-device CPU mesh."""
+    from hydrabadger_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh()
+    assert pmesh.full_crypto_epoch_sharded(mesh, n_nodes=4)
+
+
+@pytest.mark.slow
+def test_pairing_checks_sharded_across_mesh():
+    """Pairing lanes shard across the mesh: each device verifies its
+    slice of e(xG1, yG2) == e(xyG1, G2) checks."""
+    from hydrabadger_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh()
+    assert pmesh.pairing_checks_sharded(mesh, checks_per_device=1)

@@ -45,6 +45,10 @@ from .bls_jax import (
 D = 2 * N_LIMBS
 _BLK = 1024  # lane-block per Mosaic grid step (measured optimum)
 
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
 PL_COL = np.asarray(P_LIMBS, np.int32)[:, None]  # [32, 1]
 ONE_COL = np.asarray(ONE_MONT, np.int32)[:, None]
 
@@ -76,10 +80,43 @@ PF_EV, PF_OD = _split_toeplitz(T_P_FULL, D)
 # ---------------------------------------------------------------------------
 
 
+def _carry_scan_rows(x):
+    """Sequential-scan carry along axis 0 — the XLA:CPU-friendly twin of
+    _carry_ks_rows (CPU compiles the KS lookahead graphs pathologically;
+    the round-2 lesson applies to this layout too)."""
+    import jax.lax as lax
+
+    def step(c, row):
+        t = row + c
+        return t >> 12, t & LIMB_MASK
+
+    carry, limbs = lax.scan(step, jnp.zeros_like(x[0]), x)
+    return limbs
+
+
+def _sub_scan_rows(a, b):
+    import jax.lax as lax
+
+    bb = jnp.broadcast_to(b, a.shape)
+
+    def step(brw, ab):
+        ai, bi = ab
+        t = ai - bi - brw
+        neg = (t < 0).astype(jnp.int32)
+        return neg, t + (neg << 12)
+
+    borrow, limbs = lax.scan(
+        step, jnp.zeros_like(a[0]), (a, bb)
+    )
+    return limbs, borrow[None, :]
+
+
 def _carry_ks_rows(x):
     """KS carry along axis 0 (values < 2^31 - 2^19) -> canonical limbs;
     the carry out of the top row is DROPPED (callers size the width so
-    it is provably zero)."""
+    it is provably zero).  Dispatches to the scan twin off-TPU."""
+    if not _use_pallas():
+        return _carry_scan_rows(x)
     w = x.shape[0]
     for _ in range(3):
         lo = x & LIMB_MASK
@@ -100,6 +137,8 @@ def _carry_ks_rows(x):
 
 def _sub_ks_rows(a, b):
     """(a - b) with borrow -> (diff rows, borrow-out [1, B])."""
+    if not _use_pallas():
+        return _sub_scan_rows(a, b)
     t = a - b
     g = (t < 0).astype(jnp.int32)
     p = (t == 0).astype(jnp.int32)
@@ -271,10 +310,6 @@ def _jac_add_body(x1, y1, z1, x2, y2, z2, consts):
 # ---------------------------------------------------------------------------
 # Pallas wrappers (TPU) / direct bodies (CPU)
 # ---------------------------------------------------------------------------
-
-
-def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu"
 
 
 def _const_args():

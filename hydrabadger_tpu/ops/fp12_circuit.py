@@ -32,6 +32,7 @@ matmul on the MXU.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Dict, List, Sequence
 
 import jax
@@ -50,7 +51,7 @@ from .bls_jax import (
     int_to_limbs,
 )
 
-_WIDE = N_LIMBS + 3  # working width for values < 128p (< 2^388)
+_WIDE = N_LIMBS + 3  # working width for values < 2048p (< 2^393)
 _MIX_CAP = 64  # max absolute coefficient mass of any linear mix
 
 
@@ -59,6 +60,41 @@ def _to_limbs_wide(n: int, width: int) -> np.ndarray:
         [(n >> (LIMB_BITS * i)) & LIMB_MASK for i in range(width)],
         dtype=np.int32,
     )
+
+
+@lru_cache(maxsize=None)
+def _dominating_offset(mass: int, width: int = _WIDE):
+    """(K, digits[width]) with sum(digits[i] << 12i) == K*P exactly, K a
+    power of two, and digits[i] >= mass*4095 for every mix position
+    (i < 32) — a REDUNDANT decomposition of a multiple of p that
+    positionwise dominates any signed linear-mix value of coefficient
+    mass `mass`.
+
+    Why: a mix row produces limb positions in [-mass*4095, mass*4095].
+    The Kogge-Stone carry (_carry_ks) is only sound for NONNEGATIVE
+    positions — round 3 offset by the canonical limbs of K*p, whose
+    small digits leave positions negative, and a -1 deficit can survive
+    the three folding passes and corrupt the lookahead (the crafted
+    vector in tests/test_circuit_T.py demonstrates it).  Offsetting
+    by these dominating digits makes every position provably >= 0 while
+    still adding an exact multiple of p; the conditional-subtraction
+    ladder then walks K*p, K*p/2, ..., p.  Max position value after the
+    offset is 2*mass*4095 + 4095 < 2^20, comfortably inside the carry
+    contract (< 2^31 - 2^19).
+    """
+    need = mass * LIMB_MASK
+    base = sum(need << (LIMB_BITS * i) for i in range(N_LIMBS))
+    k = 1
+    while k * P < base + mass * P:  # ladder must cover offset + mix value
+        k *= 2
+    rem = k * P - base
+    assert 0 <= rem < 1 << (LIMB_BITS * width)
+    dig = np.array(
+        [(rem >> (LIMB_BITS * i)) & LIMB_MASK for i in range(width)],
+        dtype=np.int64,
+    )
+    dig[:N_LIMBS] += need
+    return k, dig.astype(np.int32)
 
 
 
@@ -257,20 +293,24 @@ class Circuit:
             t = jnp.einsum(
                 "ol,...lk->...ok", jnp.asarray(pos), have
             ) - jnp.einsum("ol,...lk->...ok", jnp.asarray(neg), have)
-        # normalize: offset +Kp (K = pow2 >= row mass, so t + Kp >= 0),
-        # wide carry, then a cond-sub ladder sized to K instead of the
-        # fixed 64 — selection-light layers pay 1-3 subs, not 7
-        k = 1
-        while k < mass:
-            k *= 2
+        # normalize: offset by the POSITIONWISE-DOMINATING redundant
+        # digits of Kp (see _dominating_offset — canonical Kp limbs left
+        # positions signed and broke the KS carry), wide carry, one
+        # UNCONDITIONAL subtract of (K - K')p with K' = pow2 >= 2*mass
+        # (provably nonnegative: V > (K - mass)p >= (K - K')p), then the
+        # short cond-sub ladder K'p, K'p/2, ..., p
+        k, off = _dominating_offset(mass)
+        kk = 1
+        while kk < 2 * mass:
+            kk *= 2
         pad = [(0, 0)] * (t.ndim - 1) + [(0, _WIDE - N_LIMBS)]
-        t = jnp.pad(t, pad) + jnp.asarray(_to_limbs_wide(k * P, _WIDE))
+        t = jnp.pad(t, pad) + jnp.asarray(off)
         t, _ = _carry_any(t)
-        kp = k
-        while kp >= 1:
-            d, borrow = _sub_any(t, jnp.asarray(_to_limbs_wide(kp * P, _WIDE)))
+        t, _ = _sub_any(t, jnp.asarray(_to_limbs_wide((k - kk) * P, _WIDE)))
+        while kk >= 1:
+            d, borrow = _sub_any(t, jnp.asarray(_to_limbs_wide(kk * P, _WIDE)))
             t = jnp.where((borrow == 0)[..., None], d, t)
-            kp //= 2
+            kk //= 2
         return t[..., :N_LIMBS]
 
     def __call__(self, inputs: jax.Array) -> jax.Array:

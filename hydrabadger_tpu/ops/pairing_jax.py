@@ -642,8 +642,9 @@ def pairing_eq_batch(g1_a, g2_b, g1_c, g2_d) -> np.ndarray:
         if not (bls.is_inf(a) or bls.is_inf(b) or bls.is_inf(c) or bls.is_inf(d))
     ]
     out = np.zeros(len(lanes), dtype=bool)
+    finite_set = set(finite)
     for i, (a, b, c, d) in enumerate(lanes):
-        if i not in set(finite):
+        if i not in finite_set:
             out[i] = bls.pairing_check_eq(a, b, c, d)
     if not finite:
         return out
@@ -651,14 +652,27 @@ def pairing_eq_batch(g1_a, g2_b, g1_c, g2_d) -> np.ndarray:
     bx, by = _g2_affine_limbs([lanes[i][1] for i in finite])
     cx, cy = _g1_affine_limbs([lanes[i][2] for i in finite])
     dx, dy = _g2_affine_limbs([lanes[i][3] for i in finite])
-    res = np.asarray(
-        _pairing_eq_kernel(
-            jnp.asarray(ax), jnp.asarray(ay),
-            jnp.asarray(bx), jnp.asarray(by),
-            jnp.asarray(cx), jnp.asarray(cy),
-            jnp.asarray(dx), jnp.asarray(dy),
-        )
-    )
+    arrs = [ax, ay, bx, by, cx, cy, dx, dy]
+    from .bls_jax import _use_mxu
+
+    if _use_mxu():
+        # fused T-layout kernels (ops/pairing_T); pad the batch so the
+        # doubled Miller batch fills whole Pallas lane blocks
+        from . import pairing_T
+        from .circuit_T import _BLK_DEFAULT
+
+        half = _BLK_DEFAULT // 2
+        pad = (-len(finite)) % half
+        if pad:
+            arrs = [
+                np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+                for a in arrs
+            ]
+        res = np.asarray(
+            pairing_T.pairing_eq_kernel_T(*map(jnp.asarray, arrs))
+        )[: len(finite)]
+    else:
+        res = np.asarray(_pairing_eq_kernel(*map(jnp.asarray, arrs)))
     for j, i in enumerate(finite):
         out[i] = res[j]
     return out

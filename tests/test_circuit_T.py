@@ -1,0 +1,133 @@
+"""Fused T-layout circuit executor (ops/circuit_T) + the round-4 mix
+soundness fix.
+
+The regression vector pins the round-3 bug: the mxu-tier _mix offset by
+the CANONICAL limbs of K*p left signed positions, and a crafted -1
+deficit survives the KS folding passes and corrupts the lookahead
+carry.  The fix (fp12_circuit._dominating_offset) makes carry inputs
+provably nonnegative; these tests pin the crafted vector under the
+forced KS tier and the executor's bit-equality against the recorded
+circuits (the CPU twins of the Pallas kernels)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydrabadger_tpu.crypto.bls12_381 import P
+from hydrabadger_tpu.ops import bls_jax as bj
+from hydrabadger_tpu.ops import circuit_T as ct
+from hydrabadger_tpu.ops import fp12_circuit as fc
+from hydrabadger_tpu.ops import pairing_jax as pj
+
+
+def _rand_elems(rng, n, b):
+    vals = [int(rng.integers(1, 2**62)) ** 2 % P for _ in range(n * b)]
+    return (
+        np.stack([bj.int_to_limbs(v) for v in vals])
+        .reshape(b, n, 32)
+        .astype(np.int32)
+    ), vals
+
+
+def test_mix_ks_signed_regression(monkeypatch):
+    """The crafted -1-deficit vector: wrong under the round-3 offset,
+    exact under the dominating offset (forced KS tier on CPU)."""
+    monkeypatch.setattr(bj, "_FQ_PATH_ENV", "mxu")
+    mask = 4095
+    m = np.array([[1, -3]], np.int32)
+    kp = [(4 * P >> (12 * i)) & mask for i in range(35)]
+    t = np.zeros(32, np.int64)
+    t[2] = -4096 - kp[2]
+    t[3], t[4], t[5] = -kp[3], -kp[4], -kp[5]
+    x0 = np.zeros(32, np.int64)
+    x1 = np.zeros(32, np.int64)
+    for j in range(32):
+        tj = int(t[j])
+        r = (-tj) % 3
+        x0[j] = (3 - r) % 3
+        x1[j] = (x0[j] - tj) // 3
+    v0 = sum(int(x0[i]) << (12 * i) for i in range(32))
+    v1 = sum(int(x1[i]) << (12 * i) for i in range(32))
+    x = np.stack([x0, x1]).astype(np.int32)[None]
+    got = np.asarray(fc.Circuit._mix(m, jnp.asarray(x)))[0, 0]
+    want = bj.int_to_limbs((v0 - 3 * v1) % P)
+    assert np.array_equal(got, want)
+
+
+def test_dominating_offset_invariants():
+    for mass in (1, 3, 17, 64):
+        k, dig = fc._dominating_offset(mass)
+        assert k & (k - 1) == 0
+        total = sum(int(d) << (12 * i) for i, d in enumerate(dig))
+        assert total == k * P
+        assert all(int(d) >= mass * 4095 for d in dig[:32])
+        assert k >= mass  # cond-sub ladder covers offset + mix value
+        assert int(dig.max()) + mass * 4095 < 2**31 - 2**19
+
+
+def _roundtrip(circ, b=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x, _ = _rand_elems(rng, circ.n_inputs, b)
+    want = np.asarray(circ(jnp.asarray(x)))
+    x_t = np.ascontiguousarray(
+        np.transpose(x, (1, 2, 0)).reshape(circ.n_inputs * 32, b)
+    )
+    got = np.asarray(ct.executor(circ)(jnp.asarray(x_t)))
+    got_bc = got.reshape(circ.n_outputs, 32, b).transpose(2, 0, 1)
+    assert np.array_equal(got_bc, want)
+
+
+def test_executor_small_circuits():
+    _roundtrip(pj._conj_circuit())
+    _roundtrip(pj._cyc_sqr_circuit())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "circ_fn",
+    [
+        pj._sqr_circuit,
+        pj._mul_circuit,
+        pj._inv_front_circuit,
+        pj._inv_back_circuit,
+        pj._miller_dbl_circuit,
+        pj._miller_add_circuit,
+    ],
+)
+def test_executor_large_circuits(circ_fn):
+    _roundtrip(circ_fn())
+
+
+@pytest.mark.slow
+def test_pairing_eq_T_end_to_end():
+    """pairing_T's full check (CPU twin of the Pallas path) against the
+    host oracle on matched and mismatched lanes."""
+    import random
+
+    from hydrabadger_tpu.crypto import bls12_381 as bls
+    from hydrabadger_tpu.ops import pairing_T as pt
+
+    rng = random.Random(5)
+    lanes = []
+    expect = []
+    for i in range(2):
+        a = bls.multiply(bls.G1, rng.randrange(1, bls.R))
+        b = bls.multiply(bls.G2, rng.randrange(1, bls.R))
+        k = rng.randrange(1, bls.R)
+        # e(a, k*b) == e(k*a, b) holds; flip one side on odd lanes
+        ka = bls.multiply(a, k if i % 2 == 0 else k + 1)
+        lanes.append((a, bls.multiply(b, k), ka, b))
+        expect.append(i % 2 == 0)
+    ax, ay = pj._g1_affine_limbs([l[0] for l in lanes])
+    bx, by = pj._g2_affine_limbs([l[1] for l in lanes])
+    cx, cy = pj._g1_affine_limbs([l[2] for l in lanes])
+    dx, dy = pj._g2_affine_limbs([l[3] for l in lanes])
+    got = np.asarray(
+        pt.pairing_eq_kernel_T(
+            *map(jnp.asarray, (ax, ay, bx, by, cx, cy, dx, dy))
+        )
+    )
+    assert got.tolist() == expect

@@ -1,0 +1,71 @@
+"""Per-circuit cost vs lane block size on the real TPU.
+
+python experiments/prof_circuit_blk.py
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from hydrabadger_tpu.ops import pairing_jax as pj
+from hydrabadger_tpu.ops.bls_jax import N_LIMBS
+from hydrabadger_tpu.ops.circuit_T import CircuitT
+from hydrabadger_tpu.ops.fq_T import fq_mul_T
+
+
+def bench_circ(name, circ, blk, b, n=8):
+    ct = CircuitT(circ, blk=blk)
+    x = np.random.randint(0, 1 << 10, (circ.n_inputs * N_LIMBS, b), np.int32)
+    xj = jnp.asarray(x)
+    np.asarray(ct(xj))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = ct(xj)
+    np.asarray(r)
+    dt = (time.perf_counter() - t0) / n
+    muls = sum(circ.n_lanes) * b
+    print(
+        f"{name:22s} blk={blk:4d} B={b:5d}: {dt*1e3:8.2f} ms"
+        f"  {dt/muls*1e9:7.1f} ns/lane-mul ({sum(circ.n_lanes)} lanes)"
+    )
+    return dt
+
+
+def bench_fq_mul(b, n=8):
+    a = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, b), np.int32))
+    c = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, b), np.int32))
+    np.asarray(fq_mul_T(a, c))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fq_mul_T(a, c)
+    np.asarray(r)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{'fq_mul_T (point prim)':22s} blk=1024 B={b:5d}: {dt*1e3:8.2f} ms  {dt/b*1e9:7.1f} ns/lane-mul")
+
+
+def main():
+    b = 1024
+    bench_fq_mul(16384)
+    for blk in (128, 256, 512, 1024):
+        try:
+            bench_circ("cyc_sqr", pj._cyc_sqr_circuit(), blk, b)
+        except Exception as e:
+            print(f"cyc_sqr blk={blk} FAILED: {type(e).__name__}: {e}")
+    for blk in (128, 256, 512):
+        try:
+            bench_circ("miller_dbl", pj._miller_dbl_circuit(), blk, 2 * b)
+        except Exception as e:
+            print(f"miller_dbl blk={blk} FAILED: {type(e).__name__}: {e}")
+    for blk in (128, 256):
+        try:
+            bench_circ("mul12", pj._mul_circuit(), blk, b)
+        except Exception as e:
+            print(f"mul12 blk={blk} FAILED: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Steady-state (jitted scan) circuit cost vs lane block + vmem limit.
+
+python experiments/prof_circuit_jit.py
+"""
+import sys
+import time
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import hydrabadger_tpu.ops.circuit_T as cT
+from hydrabadger_tpu.ops import pairing_jax as pj
+from hydrabadger_tpu.ops.bls_jax import N_LIMBS
+
+
+def bench(name, circ_fn, blk, b, iters=50, square_like=True):
+    """Time a jitted scan of the circuit applied to its own output
+    (works for any circuit whose n_inputs*32 rows can be sliced from
+    the previous output + original input)."""
+    circ = circ_fn()
+    ct = cT.CircuitT(circ, blk=blk)
+    in_rows = circ.n_inputs * N_LIMBS
+    out_rows = circ.n_outputs * N_LIMBS
+    x = jnp.asarray(
+        np.random.randint(0, 1 << 10, (in_rows, b), np.int32)
+    )
+
+    @jax.jit
+    def run(x0):
+        def step(carry, _):
+            y = ct(carry)
+            # keep shapes stable: reuse input rows where out < in
+            if out_rows >= in_rows:
+                nxt = y[:in_rows]
+            else:
+                nxt = jnp.concatenate([y, carry[out_rows:]], axis=0)
+            return nxt, None
+
+        out, _ = lax.scan(step, x0, None, length=iters)
+        return out
+
+    try:
+        np.asarray(run(x))  # compile
+    except Exception as e:
+        msg = str(e)
+        print(f"{name:14s} blk={blk:4d} FAILED: {msg[:120]}")
+        return None
+    t0 = time.perf_counter()
+    np.asarray(run(x))
+    dt = (time.perf_counter() - t0) / iters
+    muls = sum(circ.n_lanes) * b
+    print(
+        f"{name:14s} blk={blk:4d} B={b:5d}: {dt*1e3:7.3f} ms/iter"
+        f"  {dt/muls*1e9:6.1f} ns/lane-mul ({sum(circ.n_lanes)} lanes)"
+    )
+    return dt
+
+
+def main():
+    vmem = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    if vmem:
+        cT._VMEM_LIMIT = vmem * 1024 * 1024  # hook added in circuit_T
+    blks = [int(v) for v in sys.argv[2].split(",")] if len(sys.argv) > 2 else [128, 512]
+    for blk in blks:
+        bench("cyc_sqr", pj._cyc_sqr_circuit, blk, 1024)
+    for blk in blks:
+        bench("miller_dbl", pj._miller_dbl_circuit, blk, 2048)
+
+
+if __name__ == "__main__":
+    main()

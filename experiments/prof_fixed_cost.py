@@ -1,0 +1,70 @@
+"""Isolate the per-pallas_call fixed cost on this platform.
+
+python experiments/prof_fixed_cost.py
+"""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax.experimental.pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+
+
+def make_noop(rows, b, skip_barrier, inner=1):
+    def kernel(x_ref, o_ref):
+        x = x_ref[:]
+        for _ in range(inner):
+            x = x + 1
+        o_ref[:] = x
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, b), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            skip_device_barrier=skip_barrier
+        ),
+    )
+
+
+def bench(label, fn, x, iters=100):
+    @jax.jit
+    def run(a):
+        def step(c, _):
+            return fn(c), None
+
+        out, _ = lax.scan(step, a, None, length=iters)
+        return out
+
+    np.asarray(run(x))
+    t0 = time.perf_counter()
+    np.asarray(run(x))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:44s} {dt*1e6:9.1f} us/iter")
+
+
+def main():
+    x = jnp.zeros((32, 1024), jnp.int32)
+    for skip in (False, True):
+        try:
+            bench(f"noop pallas (skip_barrier={skip})", make_noop(32, 1024, skip), x)
+        except Exception as e:
+            print(f"skip_barrier={skip} failed: {str(e)[:100]}")
+    bench("plain XLA add chain", lambda c: c + 1, x)
+    # in-kernel loop: 100 adds inside ONE kernel
+    bench(
+        "pallas 100-add inner loop (1 call)",
+        make_noop(32, 1024, False, inner=100),
+        x,
+        iters=10,
+    )
+
+
+if __name__ == "__main__":
+    main()

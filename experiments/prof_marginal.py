@@ -1,0 +1,80 @@
+"""Extract per-iteration marginal cost by varying scan length.
+
+python experiments/prof_marginal.py
+"""
+import sys
+import time
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import hydrabadger_tpu.ops.circuit_T as cT
+from hydrabadger_tpu.ops import pairing_jax as pj
+from hydrabadger_tpu.ops.bls_jax import N_LIMBS
+from hydrabadger_tpu.ops.fq_T import fq_mul_T
+
+
+def run_scan(fn, x, iters):
+    @jax.jit
+    def run(a):
+        def step(c, _):
+            return fn(c), None
+
+        out, _ = lax.scan(step, a, None, length=iters)
+        return out
+
+    np.asarray(jax.tree_util.tree_leaves(run(x))[0])
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jax.tree_util.tree_leaves(run(x))[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def marginal(label, fn, x, lo, hi, muls_per_iter):
+    t_lo = run_scan(fn, x, lo)
+    t_hi = run_scan(fn, x, hi)
+    per = (t_hi - t_lo) / (hi - lo)
+    launch = t_lo - lo * per
+    print(
+        f"{label:28s} marginal {per*1e3:8.3f} ms/iter"
+        f"  ({per/muls_per_iter*1e9:6.1f} ns/lane-mul)"
+        f"  program-launch {launch*1e3:6.1f} ms"
+    )
+
+
+def main():
+    b = 1024
+    x1 = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, b), np.int32))
+    x2 = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, b), np.int32))
+    marginal(
+        "fq_mul pallas", lambda c: (fq_mul_T(c[0], c[1]), c[0]), (x1, x2),
+        20, 200, b,
+    )
+
+    sqr = cT.executor(pj._cyc_sqr_circuit())
+    f12 = jnp.asarray(
+        np.random.randint(0, 1 << 10, (12 * N_LIMBS, b), np.int32)
+    )
+    marginal("cyc_sqr circuit", sqr, f12, 20, 200, 18 * b)
+
+    dblc = pj._miller_dbl_circuit()
+    dbl = cT.executor(dblc)
+    xin = jnp.asarray(
+        np.random.randint(0, 1 << 10, (24 * N_LIMBS, 2 * b), np.int32)
+    )
+
+    def dbl_step(c):
+        out = dbl(c)
+        return jnp.concatenate([out, c[18 * N_LIMBS :]], axis=0)
+
+    marginal("miller_dbl circuit", dbl_step, xin, 10, 60, 133 * 2 * b)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Differential cost: stacked mul alone vs full circuit (mix overhead).
+
+python experiments/prof_mix_vs_mul.py
+"""
+import sys
+import time
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax.experimental.pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+
+import hydrabadger_tpu.ops.circuit_T as cT
+from hydrabadger_tpu.ops import pairing_jax as pj
+from hydrabadger_tpu.ops.bls_jax import N_LIMBS
+from hydrabadger_tpu.ops.fq_T import _const_args, _CONST_SPECS
+
+
+def timed(run, x, reps=5):
+    np.asarray(jax.tree_util.tree_leaves(run(x))[0])
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.tree_util.tree_leaves(run(x))[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def scan_of(fn, iters):
+    @jax.jit
+    def run(a):
+        def step(c, _):
+            return fn(c), None
+
+        out, _ = lax.scan(step, a, None, length=iters)
+        return out
+
+    return run
+
+
+def make_mulonly(lanes, blk, b):
+    """Kernel: one stacked _mul_rows_lazy over `lanes` lanes (the mul
+    layer of a circuit, without any mixes)."""
+
+    def kernel(*refs):
+        x = refs[0][:]
+        consts = tuple(r[:] for r in refs[1:6])
+        half = lanes * blk
+        out = cT._mul_rows_lazy(x[:, :half], x[:, half:], consts)
+        refs[6][:] = out
+
+    rows = N_LIMBS
+
+    def call(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, lanes * b), jnp.int32),
+            grid=(b // blk,),
+            in_specs=[
+                pl.BlockSpec((rows, 2 * lanes * blk), lambda i: (0, i)),
+            ]
+            + [pl.BlockSpec(s, lambda i: (0, 0)) for s in _CONST_SPECS],
+            out_specs=pl.BlockSpec((rows, lanes * blk), lambda i: (0, i)),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024
+            ),
+        )(x, *_const_args())
+
+    return call
+
+
+def main():
+    b = 1024
+    iters = 100
+    lanes = 18
+
+    x = jnp.asarray(
+        np.random.randint(0, 1 << 10, (N_LIMBS, 2 * lanes * b), np.int32)
+    )
+    for blk in (64, 128):
+        # in-kernel mul width = lanes * blk
+        mul = make_mulonly(lanes, blk, b)
+        run_mul = scan_of(
+            lambda c: jnp.concatenate([mul(c), c[:, lanes * b :]], axis=-1),
+            iters,
+        )
+        t = timed(run_mul, x, reps=3)
+        print(
+            f"stacked mul x{lanes} blk={blk:4d} (W={lanes*blk:5d}):"
+            f" {t/iters*1e3:7.3f} ms/iter"
+            f"  ({t/iters/(lanes*b)*1e9:5.1f} ns/lane-mul)"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Break down config 7 time: host prep vs Miller vs final-exp vs verdict.
+
+Run on the real TPU:  python experiments/prof_pairing.py [batch]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from hydrabadger_tpu.crypto import bls12_381 as bls
+from hydrabadger_tpu.ops import pairing_jax, pairing_T
+from hydrabadger_tpu.ops.pairing_jax import _g1_affine_limbs, _g2_affine_limbs
+from hydrabadger_tpu.ops.pairing_T import (
+    _final_exp_is_one_T,
+    _fq12_mul_T,
+    _miller_T,
+    _neg_fq_T,
+    _to_rows1,
+    _to_rows2,
+)
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+
+
+def timeit(label, fn, n=3):
+    np.asarray(jax.tree_util.tree_leaves(fn())[0])  # warm/compile + sync
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    np.asarray(jax.tree_util.tree_leaves(r)[0])  # device->host forces completion
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:38s} {dt*1e3:9.1f} ms   {dt/B*1e9:8.0f} ns/lane")
+    return dt
+
+
+def main():
+    import random
+
+    rng = random.Random(1)
+    # random valid pairing instances: e(sk*G1, Q) == e(G1, sk*Q)
+    g1s, g2s, g1c, g2d = [], [], [], []
+    for _ in range(B):
+        sk = rng.randrange(1, bls.R)
+        g1s.append(bls.multiply(bls.G1, sk))
+        g2s.append(bls.G2)
+        g1c.append(bls.G1)
+        g2d.append(bls.multiply(bls.G2, sk))
+
+    t0 = time.perf_counter()
+    ax, ay = _g1_affine_limbs(g1s)
+    bx, by = _g2_affine_limbs(g2s)
+    cx, cy = _g1_affine_limbs(g1c)
+    dx, dy = _g2_affine_limbs(g2d)
+    t_prep = time.perf_counter() - t0
+    print(f"{'host prep (affine+limbs)':38s} {t_prep*1e3:9.1f} ms")
+
+    arrs = [jnp.asarray(a) for a in (ax, ay, bx, by, cx, cy, dx, dy)]
+    axj, ayj, bxj, byj, cxj, cyj, dxj, dyj = arrs
+
+    p_x = jnp.concatenate([_to_rows1(axj), _to_rows1(cxj)], axis=-1)
+    p_y = jnp.concatenate([_to_rows1(ayj), _neg_fq_T(_to_rows1(cyj))], axis=-1)
+    q_x = jnp.concatenate([_to_rows2(bxj), _to_rows2(dxj)], axis=-1)
+    q_y = jnp.concatenate([_to_rows2(byj), _to_rows2(dyj)], axis=-1)
+
+    miller_j = jax.jit(_miller_T)
+    t_miller = timeit("miller_T (2B lanes)", lambda: jax.block_until_ready(
+        miller_j(q_x, q_y, p_x, p_y)))
+    fboth = miller_j(q_x, q_y, p_x, p_y)
+    f = _fq12_mul_T(fboth[:, :B], fboth[:, B:])
+    fexp_j = jax.jit(_final_exp_is_one_T)
+    t_fexp = timeit("final_exp_is_one_T", lambda: jax.block_until_ready(
+        fexp_j(f)))
+
+    t_all = timeit("pairing_eq_kernel_T end-to-end", lambda: jax.block_until_ready(
+        pairing_T.pairing_eq_kernel_T(*arrs)))
+
+    print(f"\nbatch={B}  backend={jax.default_backend()}")
+    print(f"host prep:   {t_prep*1e3:8.1f} ms ({t_prep/(t_prep+t_all)*100:.0f}% of e2e+prep)")
+    print(f"kernel e2e:  {t_all*1e3:8.1f} ms  -> {B/(t_prep+t_all):.0f} shares/s incl prep, {B/t_all:.0f} kernel-only")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Steady-state cost of the fq_T point kernels (the 6-7 ns/mul claim).
+
+python experiments/prof_point_jit.py [B]
+"""
+import sys
+import time
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from hydrabadger_tpu.ops.bls_jax import N_LIMBS
+from hydrabadger_tpu.ops.fq_T import fq_mul_T, jac_add_T, jac_double_T
+
+
+def bench(name, fn, arrs, muls_per_iter, iters=50):
+    @jax.jit
+    def run(a):
+        def step(c, _):
+            out = fn(c)
+            return out, None
+
+        out, _ = lax.scan(step, a, None, length=iters)
+        return out
+
+    np.asarray(jax.tree_util.tree_leaves(run(arrs))[0])
+    t0 = time.perf_counter()
+    np.asarray(jax.tree_util.tree_leaves(run(arrs))[0])
+    dt = (time.perf_counter() - t0) / iters
+    print(
+        f"{name:12s}: {dt*1e3:7.3f} ms/iter  {dt/muls_per_iter*1e9:6.1f} ns/lane-mul"
+    )
+
+
+def main():
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    x = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, b), np.int32))
+    y = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, b), np.int32))
+    z = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, b), np.int32))
+    bench("fq_mul", lambda c: (fq_mul_T(c[0], c[1]), c[0]), (x, y), b)
+    bench(
+        "jac_double",
+        lambda c: jac_double_T(c),
+        (x, y, z),
+        7 * b,
+    )
+    bench(
+        "jac_add",
+        lambda c: (*jac_add_T(c[:3], c[3:]), *c[:3]),
+        (x, y, z, y, z, x),
+        23 * b,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,9 +20,23 @@ linear mixes produce SIGNED limb positions, and the Kogge-Stone carry
 is only sound for nonnegative inputs.  Every general mix row is offset
 by a REDUNDANT decomposition of K*p whose digits positionwise dominate
 the mix range (fp12_circuit._dominating_offset), so carry inputs are
-provably >= 0; a conditional-subtraction ladder K*p, K*p/2, ..., p then
-canonicalises.  Pure-selection rows skip normalization entirely and
-single -1 rows use the branch-free field negation.
+provably >= 0.
+
+Reduction (round-4 rev 2): instead of walking a conditional-subtraction
+ladder K*p, K*p/2, ..., p (log K Kogge-Stone passes — measured as the
+dominant circuit cost, 25-35 ns/lane-mul vs 2.3 ns for the raw
+Montgomery multiply), the carried value V < (K + 2*mass)*p is reduced
+by ONE Barrett quotient step: u = floor(V / 2^372) read from limb rows
+31/32, q = (u * M) >> 18 with M = floor(2^390 / p).  q never exceeds
+the true quotient (both floors round down), and undershoots by at most
+floor(V/2^390 + M/2^18 + 1) — a small, statically computed bound that
+sizes a SHORT tail ladder (usually 0-2 levels).  Wires between layers
+live in a redundant < 2p representation: the Montgomery product of
+a, b <= 2p is < 1.5p without the final conditional subtract (4p^2 < Rp
+since 4p < 2^384), so the mul layer skips it; only the output mix
+canonicalises to < p.  Pure-selection rows skip normalization (with a
+single conditional subtract at the canonical output boundary); single
+-1 rows become 2p - y (y <= 2p), exact mod p.
 
 Backend split mirrors fq_T: on TPU the kernel is a Mosaic program; on
 CPU the SAME body runs as plain traced XLA (scan carries) — bit-exact
@@ -48,7 +62,7 @@ from .fq_T import (
     _carry_ks_rows,
     _const_args,
     _CONST_SPECS,
-    _mul_rows,
+    _mul_rows_lazy,
     _pad_lanes,
     _sub_ks_rows,
     _sub_rows,
@@ -57,6 +71,13 @@ from .fq_T import (
 
 _WIDE = N_LIMBS + 3
 _BLK_DEFAULT = 128  # lane block per grid step (VMEM-bound: whole circuits live on-chip)
+_BARRETT_M = (1 << 390) // P  # 10-bit reciprocal for the quotient step
+# Mosaic's default scoped-VMEM allotment is 16 MiB — a fraction of the
+# 128 MiB physically on a v5e core.  The whole-circuit kernels are
+# VMEM-resident by design, so they get the real budget (measured: the
+# dbl circuit OOMs the 16 MiB default at blk=256 while the chip is
+# mostly empty).
+_VMEM_LIMIT = 100 * 1024 * 1024
 
 
 class _MixPlan:
@@ -120,28 +141,39 @@ class CircuitT:
                 cols.append(v.astype(np.int32))
             return index[key]
 
-        def norm_cols(mass: int):
+        def norm_cols(mass: int, target: int):
+            """Barrett normalize plan for a mix of row mass `mass` over
+            wires < 2p, reducing to < target*p (2 between layers, 1 at
+            the canonical output)."""
             if mass == 0:
                 return None
-            k, off = _dominating_offset(mass, _WIDE)
-            kk = 1
-            while kk < 2 * mass:
-                kk *= 2
+            eff = 2 * mass  # wires are < 2p, so |mix value| < eff * p
+            k, off = _dominating_offset(eff, _WIDE)
+            bound_mult = k + eff  # V = offset + mix < bound_mult * p
+            # u = floor(V / 2^372) must sit entirely in rows 31/32 and
+            # u * M must stay inside int32
+            assert bound_mult * P < 1 << 392
+            # q = (u * M) >> 18 <= true quotient; deficit bound:
+            # V/2^390 + M/2^18 + 1 (see module docstring)
+            deficit = (
+                bound_mult * P + _BARRETT_M * (1 << 372) + (1 << 390)
+            ) // (1 << 390)
+            rem_mult = deficit + 1  # remainder < rem_mult * p
             off_i = col(off)
-            # one UNCONDITIONAL subtract of (K - K')p (V > (K - mass)p
-            # >= (K - K')p keeps it nonnegative), then the short ladder
-            uncond_i = col(_to_limbs_wide((k - kk) * P, _WIDE))
             levels = []
-            while kk >= 1:
-                levels.append(col(_to_limbs_wide(kk * P, _WIDE)))
-                kk //= 2
-            return off_i, uncond_i, levels
+            while rem_mult > target:
+                lev = 1 << ((rem_mult - 1).bit_length() - 1)
+                levels.append(col(_to_limbs_wide(lev * P, _WIDE)))
+                rem_mult = lev
+            return off_i, levels
 
         self.layer_norms = [
-            norm_cols(max(pl.mass, pr.mass))
+            norm_cols(max(pl.mass, pr.mass), 2)
             for pl, pr in self.layer_plans
         ]
-        self.out_norm = norm_cols(self.out_plan.mass)
+        self.out_norm = norm_cols(self.out_plan.mass, 1)
+        self.p_i = col(_to_limbs_wide(P, _WIDE))
+        self.twop_i = col(_to_limbs_wide(2 * P, _WIDE))
         self.norm_mat = (
             np.stack(cols, axis=1)
             if cols
@@ -158,12 +190,16 @@ class CircuitT:
     # -- traced body (runs inside the Pallas kernel on TPU, as plain
     # XLA on CPU) ----------------------------------------------------------
 
-    def _run_mixes(self, plans, norm, wires, norm_ref, p_col, width):
+    def _run_mixes(
+        self, plans, norm, wires, norm_ref, p_col, width, canonical=False
+    ):
         """Evaluate one or two mix plans sharing a normalize group.
 
         plans: list of _MixPlan; returns a list (per plan) of lists of
-        [32, width] canonical outputs."""
+        [32, width] outputs — < 2p between layers, < p (canonical) when
+        `canonical` is set (the output mix)."""
         outs = [[None] * p.n_out for p in plans]
+        n_fixed = self.n_inputs + self.n_const  # inputs/consts are < p
         gen: List[Tuple[int, int, jax.Array]] = []
         for pi, plan in enumerate(plans):
             for o, terms in plan.general:
@@ -173,7 +209,7 @@ class CircuitT:
                     acc = term if acc is None else acc + term
                 gen.append((pi, o, jnp.broadcast_to(acc, (N_LIMBS, width))))
         if gen:
-            off_i, uncond_i, levels = norm
+            off_i, levels = norm
             stacked = jnp.concatenate([a for _, _, a in gen], axis=-1)
             zpad = jnp.zeros(
                 (_WIDE - N_LIMBS, stacked.shape[-1]), jnp.int32
@@ -181,23 +217,46 @@ class CircuitT:
             stacked = jnp.concatenate([stacked, zpad], axis=0)
             stacked = stacked + norm_ref[:, off_i : off_i + 1]
             stacked = _carry_ks_rows(stacked)
-            stacked, _ = _sub_ks_rows(
-                stacked, norm_ref[:, uncond_i : uncond_i + 1]
-            )
+            # Barrett quotient from the top limbs (rows 33/34 provably
+            # zero), then one exact q*p subtract; never overshoots
+            u = stacked[31:32] + (stacked[32:33] << 12)
+            q = (u * _BARRETT_M) >> 18
+            qp = _carry_ks_rows(norm_ref[:, self.p_i : self.p_i + 1] * q)
+            stacked, _ = _sub_ks_rows(stacked, qp)
+            stacked = stacked[:N_LIMBS]
             for lev in levels:
                 d, borrow = _sub_ks_rows(
-                    stacked, norm_ref[:, lev : lev + 1]
+                    stacked, norm_ref[:N_LIMBS, lev : lev + 1]
                 )
                 stacked = jnp.where(borrow == 0, d, stacked)
-            stacked = stacked[:N_LIMBS]
             for i, (pi, o, _) in enumerate(gen):
                 outs[pi][o] = stacked[:, i * width : (i + 1) * width]
+
+        p32 = norm_ref[:N_LIMBS, self.p_i : self.p_i + 1]
+        twop32 = norm_ref[:N_LIMBS, self.twop_i : self.twop_i + 1]
+
+        def cond_sub(v, m):
+            d, borrow = _sub_ks_rows(v, m)
+            return jnp.where(borrow == 0, d, v)
+
         for pi, plan in enumerate(plans):
             for o, w in plan.select:
-                outs[pi][o] = jnp.broadcast_to(wires[w], (N_LIMBS, width))
+                v = jnp.broadcast_to(wires[w], (N_LIMBS, width))
+                if canonical and w >= n_fixed:
+                    v = cond_sub(v, p32)
+                outs[pi][o] = v
             for o, w in plan.negsel:
                 src = jnp.broadcast_to(wires[w], (N_LIMBS, width))
-                outs[pi][o] = _sub_rows(jnp.zeros_like(src), src, p_col)
+                if w < n_fixed:
+                    # canonical source: p - y (exact, maps 0 -> 0)
+                    v = _sub_rows(jnp.zeros_like(src), src, p_col)
+                else:
+                    v, _ = _sub_ks_rows(  # 2p - y, y <= 2p
+                        jnp.broadcast_to(twop32, src.shape), src
+                    )
+                    if canonical:
+                        v = cond_sub(cond_sub(v, p32), p32)
+                outs[pi][o] = v
             for o in plan.zero:
                 outs[pi][o] = jnp.zeros((N_LIMBS, width), jnp.int32)
         return outs
@@ -218,11 +277,17 @@ class CircuitT:
             lanes = len(louts)
             ls = jnp.concatenate(louts, axis=-1)
             rs = jnp.concatenate(routs, axis=-1)
-            prods = _mul_rows(ls, rs, mul_consts)
+            prods = _mul_rows_lazy(ls, rs, mul_consts)
             for i in range(lanes):
                 wires.append(prods[:, i * width : (i + 1) * width])
         (outs,) = self._run_mixes(
-            [self.out_plan], self.out_norm, wires, norm_ref, p_col, width
+            [self.out_plan],
+            self.out_norm,
+            wires,
+            norm_ref,
+            p_col,
+            width,
+            canonical=True,
         )
         return outs
 
@@ -250,6 +315,7 @@ class CircuitT:
         if b in self._pallas_fns:
             return self._pallas_fns[b]
         import jax.experimental.pallas as pl
+        import jax.experimental.pallas.tpu as pltpu
 
         blk = self.blk
         n_in_rows = self.n_inputs * N_LIMBS
@@ -281,6 +347,9 @@ class CircuitT:
                 for shape in _CONST_SPECS
             ],
             out_specs=pl.BlockSpec((n_out_rows, blk), lambda i: (0, i)),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_VMEM_LIMIT
+            ),
         )
         self._pallas_fns[b] = fn
         return fn

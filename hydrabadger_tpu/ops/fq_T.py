@@ -200,14 +200,21 @@ def _conv_rows(a, b):
     return acc
 
 
-def _mul_rows(a, b, consts):
-    """Montgomery product on [32, B] rows (the fused pipeline)."""
-    pinv_ev, pinv_od, pf_ev, pf_od, p_col = consts
+def _mul_rows_lazy(a, b, consts):
+    """Montgomery product on [32, B] rows WITHOUT the final conditional
+    subtract: for a, b <= 2p the result is < 1.5p (4p^2 < Rp), which the
+    circuit executor's redundant wire representation accepts."""
+    pinv_ev, pinv_od, pf_ev, pf_od, _ = consts
     cn = _carry_ks_rows(_conv_rows(a, b))  # [64, B]
     m = _carry_ks_rows(_shared_conv(cn[:N_LIMBS], pinv_ev, pinv_od))
     t = _carry_ks_rows(cn + _shared_conv(m, pf_ev, pf_od))
-    r = t[N_LIMBS:]
-    d, borrow = _sub_ks_rows(r, p_col)
+    return t[N_LIMBS:]
+
+
+def _mul_rows(a, b, consts):
+    """Montgomery product on [32, B] rows (the fused pipeline)."""
+    r = _mul_rows_lazy(a, b, consts)
+    d, borrow = _sub_ks_rows(r, consts[4])
     return jnp.where(borrow == 0, d, r)
 
 

@@ -19,6 +19,11 @@ from __future__ import annotations
 
 from typing import Any
 
+# Shared with native/hb_codec.c (MAX_DEPTH): both twins must reject the
+# same adversarial nesting with the same error type, or nodes running
+# different codec builds would accept/crash divergently on one frame.
+_MAX_DEPTH = 500
+
 
 def _write_uvarint(out: bytearray, n: int) -> None:
     if n < 0:
@@ -47,7 +52,9 @@ def _read_uvarint(buf: bytes, pos: int) -> tuple[int, int]:
         shift += 7
 
 
-def _encode_into(out: bytearray, value: Any) -> None:
+def _encode_into(out: bytearray, value: Any, depth: int = 0) -> None:
+    if depth > _MAX_DEPTH:
+        raise ValueError("codec nesting too deep")
     if value is None:
         out.append(ord("N"))
     elif value is True:
@@ -72,16 +79,16 @@ def _encode_into(out: bytearray, value: Any) -> None:
         out.append(ord("L"))
         _write_uvarint(out, len(value))
         for item in value:
-            _encode_into(out, item)
+            _encode_into(out, item, depth + 1)
     elif isinstance(value, dict):
         out.append(ord("D"))
         _write_uvarint(out, len(value))
         entries = []
         for k, v in value.items():
             kb = bytearray()
-            _encode_into(kb, k)
+            _encode_into(kb, k, depth + 1)
             vb = bytearray()
-            _encode_into(vb, v)
+            _encode_into(vb, v, depth + 1)
             entries.append((bytes(kb), bytes(vb)))
         entries.sort(key=lambda e: e[0])
         for kb, vb in entries:
@@ -91,13 +98,15 @@ def _encode_into(out: bytearray, value: Any) -> None:
         raise TypeError(f"codec cannot encode {type(value).__name__}")
 
 
-def encode(value: Any) -> bytes:
+def _py_encode(value: Any) -> bytes:
     out = bytearray()
     _encode_into(out, value)
     return bytes(out)
 
 
-def _decode_at(buf: bytes, pos: int) -> tuple[Any, int]:
+def _decode_at(buf: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
+    if depth > _MAX_DEPTH:
+        raise ValueError("codec nesting too deep")
     if pos >= len(buf):
         raise ValueError("truncated value")
     tag = buf[pos]
@@ -125,22 +134,67 @@ def _decode_at(buf: bytes, pos: int) -> tuple[Any, int]:
         n, pos = _read_uvarint(buf, pos)
         items = []
         for _ in range(n):
-            item, pos = _decode_at(buf, pos)
+            item, pos = _decode_at(buf, pos, depth + 1)
             items.append(item)
         return tuple(items), pos
     if tag == ord("D"):
         n, pos = _read_uvarint(buf, pos)
         out = {}
         for _ in range(n):
-            k, pos = _decode_at(buf, pos)
-            v, pos = _decode_at(buf, pos)
+            k, pos = _decode_at(buf, pos, depth + 1)
+            v, pos = _decode_at(buf, pos, depth + 1)
             out[k] = v
         return out, pos
     raise ValueError(f"unknown tag byte {tag!r}")
 
 
-def decode(buf: bytes) -> Any:
+def _py_decode(buf: bytes) -> Any:
     value, pos = _decode_at(bytes(buf), 0)
     if pos != len(buf):
         raise ValueError(f"{len(buf) - pos} trailing bytes")
     return value
+
+
+def _load_native():
+    """native/hb_codec.so — the C twin (role of the reference's native
+    bincode, src/lib.rs:400-403).  Byte-identical to the Python
+    implementation above (pinned by tests/test_codec.py); the 128-node
+    era switch decodes ~34 MB/node of committed DKG payloads, which
+    pure Python serviced ~50x slower."""
+    import os
+
+    if os.environ.get("HB_NATIVE_CODEC", "1") != "1":
+        return None
+    import importlib.util
+    from pathlib import Path
+
+    so = Path(__file__).resolve().parents[2] / "native" / "hb_codec.so"
+    if not so.exists():
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location("hb_codec", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # self-check before trusting it on the signing path
+        probe = (1, -(2**381), b"x", "s", {3: (None, True)}, 2**64)
+        if mod.encode(probe) != _py_encode(probe):
+            return None
+        if mod.decode(mod.encode(probe)) != probe:
+            return None
+        return mod
+    except Exception:
+        return None
+
+
+_native = _load_native()
+
+if _native is not None:
+    encode = _native.encode
+    decode = _native.decode
+else:
+    encode = _py_encode
+    decode = _py_decode
+
+
+def native_active() -> bool:
+    return _native is not None

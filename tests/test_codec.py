@@ -63,3 +63,107 @@ def test_uid_ordering_and_hash():
     a, b = Uid(b"\x00" * 16), Uid(b"\xff" * 16)
     assert a < b
     assert len({a, b, Uid(a.bytes)}) == 2
+
+
+# -- native twin (native/hb_codec.c) ----------------------------------------
+
+
+def _randomized_values(seed, n):
+    import random
+
+    rng = random.Random(seed)
+
+    def rnd(depth=0):
+        t = rng.randrange(0, 9 if depth < 4 else 6)
+        if t == 0:
+            return None
+        if t == 1:
+            return rng.random() < 0.5
+        if t == 2:
+            return rng.randrange(-(10**6), 10**6)
+        if t == 3:
+            sign = 1 if rng.random() < 0.5 else -1
+            return sign * rng.getrandbits(rng.randrange(60, 600))
+        if t == 4:
+            return bytes(rng.randrange(256) for _ in range(rng.randrange(40)))
+        if t == 5:
+            return "".join(
+                chr(rng.randrange(32, 0x2000)) for _ in range(rng.randrange(20))
+            )
+        if t == 6:
+            return tuple(rnd(depth + 1) for _ in range(rng.randrange(6)))
+        if t == 7:
+            return [rnd(depth + 1) for _ in range(rng.randrange(6))]
+        return {rng.getrandbits(32): rnd(depth + 1) for _ in range(rng.randrange(5))}
+
+    return [rnd() for _ in range(n)]
+
+
+_EDGE_INTS = [
+    0, 1, -1, 63, 64, -64, -65, 2**62 - 1, 2**62, -(2**62), 2**63 - 1,
+    -(2**63), 2**63, 2**64 - 1, 2**64, -(2**64), 2**100, -(2**100),
+    2**381 - 1, 2**381, -(2**381), 2**448 - 1, 2**511,
+]
+
+
+@pytest.mark.skipif(not codec.native_active(), reason="native codec not built")
+def test_native_bitexact_randomized():
+    for v in _randomized_values(1234, 500) + _EDGE_INTS + CASES:
+        pe = codec._py_encode(v)
+        assert codec._native.encode(v) == pe, v
+        assert codec._native.decode(pe) == codec._py_decode(pe)
+
+
+@pytest.mark.skipif(not codec.native_active(), reason="native codec not built")
+def test_native_decode_type_fidelity():
+    v = (1, [2, 3], {b"k": "s"}, None, True, b"\x00")
+    nd = codec._native.decode(codec._py_encode(v))
+    pd = codec._py_decode(codec._py_encode(v))
+    assert nd == pd
+    assert type(nd) is type(pd)
+    assert type(nd[1]) is tuple  # lists decode as tuples in both
+
+
+@pytest.mark.skipif(not codec.native_active(), reason="native codec not built")
+def test_native_error_parity():
+    bad = [
+        b"",  # empty
+        b"Z",  # unknown tag
+        b"I",  # truncated varint
+        b"B\x05ab",  # truncated bytes
+        b"L\x02N",  # truncated list
+        codec._py_encode(1) + b"\x00",  # trailing
+        b"B\xff\xff\xff\xff\xff\xff\xff\xff\xff\x7f",  # huge length
+    ]
+    for buf in bad:
+        with pytest.raises(ValueError):
+            codec._native.decode(buf)
+        with pytest.raises(ValueError):
+            codec._py_decode(buf)
+
+
+@pytest.mark.skipif(not codec.native_active(), reason="native codec not built")
+def test_native_encode_type_errors():
+    for v in [1.5, object(), {1: object()}]:
+        with pytest.raises(TypeError):
+            codec._native.encode(v)
+        with pytest.raises(TypeError):
+            codec._py_encode(v)
+
+
+@pytest.mark.skipif(not codec.native_active(), reason="native codec not built")
+def test_depth_guard_parity():
+    deep = b"L\x01" * 600 + b"N"
+    with pytest.raises(ValueError):
+        codec._py_decode(deep)
+    with pytest.raises(ValueError):
+        codec._native.decode(deep)
+    ok = b"L\x01" * 400 + b"N"
+    assert codec._py_decode(ok) == codec._native.decode(ok)
+    nested = None
+    for _ in range(600):
+        nested = (nested,)
+    with pytest.raises(ValueError):
+        codec._py_encode(nested)
+    with pytest.raises(ValueError):
+        codec._native.encode(nested)

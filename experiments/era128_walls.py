@@ -1,0 +1,50 @@
+"""Time the 128-node era switch end-to-end, per epoch, no profiler.
+
+python experiments/era128_walls.py [nodes]
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+
+def main():
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    txns = max(1, 4096 // n_nodes)
+    t00 = time.perf_counter()
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=n_nodes,
+            protocol="dhb",
+            txns_per_node_per_epoch=txns,
+            txn_bytes=2,
+            seed=0,
+        )
+    )
+    net.run(1)
+    print(f"steady epoch: {time.perf_counter()-t00:.1f}s", flush=True)
+    victim = net.ids[-1]
+    for nid in net.ids:
+        if nid != victim:
+            net.router.dispatch_step(nid, net.nodes[nid].vote_to_remove(victim))
+    t0 = time.perf_counter()
+    for i in range(10):
+        te = time.perf_counter()
+        net.run(1)
+        done = all(
+            net.nodes[nid].era > 0 for nid in net.ids if nid != victim
+        )
+        print(
+            f"era epoch {i}: {time.perf_counter()-te:.1f}s"
+            f" (cum {time.perf_counter()-t0:.1f}s) switched={done}",
+            flush=True,
+        )
+        if done:
+            break
+    print(f"era switch total: {time.perf_counter()-t0:.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Profile the 128-node era switch (config 5 shape) under cProfile.
+
+python experiments/prof_era128.py [nodes]
+"""
+import cProfile
+import pstats
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+
+def main():
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    txns = max(1, 4096 // n_nodes)
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=n_nodes,
+            protocol="dhb",
+            txns_per_node_per_epoch=txns,
+            txn_bytes=2,
+            seed=0,
+        )
+    )
+    t0 = time.perf_counter()
+    net.run(1)
+    print(f"epoch 1 (steady): {time.perf_counter()-t0:.1f}s", flush=True)
+    victim = net.ids[-1]
+    for nid in net.ids:
+        if nid != victim:
+            net.router.dispatch_step(nid, net.nodes[nid].vote_to_remove(victim))
+
+    prof = cProfile.Profile()
+    prof.enable()
+    t0 = time.perf_counter()
+    for i in range(2):
+        net.run(1)
+        done = all(
+            net.nodes[nid].era > 0 for nid in net.ids if nid != victim
+        )
+        print(
+            f"era epoch {i}: {time.perf_counter()-t0:.1f}s cumulative,"
+            f" switched={done}",
+            flush=True,
+        )
+        if done:
+            break
+    prof.disable()
+    stats = pstats.Stats(prof)
+    stats.sort_stats("cumulative").print_stats(30)
+    stats.sort_stats("tottime").print_stats(30)
+
+
+if __name__ == "__main__":
+    main()

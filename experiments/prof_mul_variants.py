@@ -1,0 +1,144 @@
+"""Isolate _mul_rows component costs inside one kernel.
+
+Chained x = op(x, b) inner fori_loops at two lengths; the delta cancels
+program-launch jitter.  python experiments/prof_mul_variants.py [B]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax.experimental.pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+
+from hydrabadger_tpu.ops.bls_jax import LIMB_MASK, N_LIMBS
+from hydrabadger_tpu.ops.fq_T import (
+    _carry_ks_rows,
+    _const_args,
+    _CONST_SPECS,
+    _conv_rows,
+    _mul_rows,
+    _shared_conv,
+    _sub_ks_rows,
+)
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+
+
+def make_kernel(body, iters):
+    def kernel(*refs):
+        x = refs[0][:]
+        b = refs[1][:]
+        consts = tuple(r[:] for r in refs[2:7])
+
+        def step(_, xx):
+            return body(xx, b, consts)
+
+        refs[7][:] = jax.lax.fori_loop(0, iters, step, x)
+
+    def call(x, b):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((N_LIMBS, B), jnp.int32),
+            in_specs=[pl.BlockSpec((N_LIMBS, B), lambda: (0, 0))] * 2
+            + [pl.BlockSpec(s, lambda: (0, 0)) for s in _CONST_SPECS],
+            out_specs=pl.BlockSpec((N_LIMBS, B), lambda: (0, 0)),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024
+            ),
+        )(x, b, *_const_args())
+
+    return call
+
+
+def measure(name, body, lo=10, hi=110):
+    x = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, B), np.int32))
+    y = jnp.asarray(np.random.randint(0, 1 << 10, (N_LIMBS, B), np.int32))
+    ts = {}
+    for iters in (lo, hi):
+        fn = jax.jit(make_kernel(body, iters))
+        np.asarray(fn(x, y))
+        best = 1e9
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(fn(x, y))
+            best = min(best, time.perf_counter() - t0)
+        ts[iters] = best
+    per = (ts[hi] - ts[lo]) / (hi - lo)
+    print(f"{name:26s} {per*1e6:9.2f} us/op  ({per/B*1e9:6.2f} ns/lane)")
+
+
+def _conv_f32(a, b, rows):
+    """Schoolbook conv of [32, B] f32 rows -> [rows, B] f32."""
+    zrow = jnp.zeros_like(b[:1])
+    acc = None
+    for i in range(N_LIMBS):
+        parts = []
+        if i:
+            parts.append(jnp.concatenate([zrow] * i, axis=0) if i > 1 else zrow)
+        parts.append(a[i : i + 1] * b)
+        tail = rows - i - N_LIMBS
+        if tail:
+            parts.append(
+                jnp.concatenate([zrow] * tail, axis=0) if tail > 1 else zrow
+            )
+        shifted = jnp.concatenate(parts, axis=0)
+        acc = shifted if acc is None else acc + shifted
+    return acc
+
+
+def f32_conv_mul(a, b, consts):
+    """Montgomery mul with the main conv as 4 f32 digit convs (6-bit
+    digits kept as separate lo/hi arrays — no strided slices)."""
+    pinv_ev, pinv_od, pf_ev, pf_od, p_col = consts
+    al = (a & 63).astype(jnp.float32)
+    ah = (a >> 6).astype(jnp.float32)
+    bl = (b & 63).astype(jnp.float32)
+    bh = (b >> 6).astype(jnp.float32)
+    n = 2 * N_LIMBS
+    c_ll = _conv_f32(al, bl, n)
+    c_x = _conv_f32(al, bh, n) + _conv_f32(ah, bl, n)
+    c_hh = _conv_f32(ah, bh, n)
+    zrow = jnp.zeros_like(c_hh[:1])
+    hh_shift = jnp.concatenate([zrow, c_hh[: n - 1]], axis=0)
+    # c_hh[k] carries weight 2^12 at position k == one whole row up
+    pos = (
+        c_ll.astype(jnp.int32)
+        + (c_x.astype(jnp.int32) << 6)
+        + hh_shift.astype(jnp.int32)
+    )
+    cn = _carry_ks_rows(pos)  # [64, B]
+    m = _carry_ks_rows(_shared_conv(cn[:N_LIMBS], pinv_ev, pinv_od))
+    t = _carry_ks_rows(cn + _shared_conv(m, pf_ev, pf_od))
+    r = t[N_LIMBS:]
+    d, borrow = _sub_ks_rows(r, p_col)
+    return jnp.where(borrow == 0, d, r)
+
+
+def main():
+    # correctness: f32 variant must equal the int32 pipeline bit-exactly
+    xa = jnp.asarray(np.random.randint(0, 1 << 12, (N_LIMBS, 256), np.int32))
+    xb = jnp.asarray(np.random.randint(0, 1 << 12, (N_LIMBS, 256), np.int32))
+    ref = jax.jit(_mul_rows)(xa, xb, _const_args())
+    got = jax.jit(f32_conv_mul)(xa, xb, _const_args())
+    assert (np.asarray(ref) == np.asarray(got)).all(), "f32 conv mismatch"
+    print("f32 conv bit-exact vs int32 pipeline")
+
+    measure("full _mul_rows (int32)", _mul_rows)
+    measure("f32-digit conv mul", f32_conv_mul)
+    measure(
+        "conv only (int32) + mask",
+        lambda a, b, c: _conv_rows(a, b)[:N_LIMBS] & LIMB_MASK,
+    )
+    measure(
+        "carry only",
+        lambda a, b, c: _carry_ks_rows(a + b),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -123,16 +123,26 @@ def g1_msm_or_fallback(points, scalars):
     return acc
 
 
-def _seal(key: bytes, ctx: bytes, msg: bytes) -> bytes:
-    ks = b""
+def _keystream_xor(key: bytes, ctx: bytes, data: bytes) -> bytes:
+    """XOR with the SHA-256 counter keystream (one int-wide XOR — the
+    byte-wise generator was measurable at era-switch volume)."""
+    parts = []
     ctr = 0
-    while len(ks) < len(msg):
-        ks += hashlib.sha256(
-            key + b"|enc|" + ctx + ctr.to_bytes(4, "big")
-        ).digest()
+    prefix = key + b"|enc|" + ctx
+    while 32 * ctr < len(data):
+        parts.append(hashlib.sha256(prefix + ctr.to_bytes(4, "big")).digest())
         ctr += 1
-    ct = bytes(a ^ b for a, b in zip(msg, ks))
-    tag = hmac_mod.new(key, b"|mac|" + ctx + ct, hashlib.sha256).digest()[:16]
+    ks = b"".join(parts)[: len(data)]
+    return (
+        int.from_bytes(data, "big") ^ int.from_bytes(ks, "big")
+    ).to_bytes(len(data), "big")
+
+
+def _seal(key: bytes, ctx: bytes, msg: bytes) -> bytes:
+    ct = _keystream_xor(key, ctx, msg)
+    # one-shot C hmac path: ~3x the hmac.new object dance at the
+    # 2M-call volume of a 128-node era switch
+    tag = hmac_mod.digest(key, b"|mac|" + ctx + ct, "sha256")[:16]
     return ct + tag
 
 
@@ -140,17 +150,10 @@ def _open(key: bytes, ctx: bytes, blob: bytes) -> Optional[bytes]:
     if len(blob) < 16:
         return None
     ct, tag = blob[:-16], blob[-16:]
-    want = hmac_mod.new(key, b"|mac|" + ctx + ct, hashlib.sha256).digest()[:16]
+    want = hmac_mod.digest(key, b"|mac|" + ctx + ct, "sha256")[:16]
     if not hmac_mod.compare_digest(want, tag):
         return None
-    ks = b""
-    ctr = 0
-    while len(ks) < len(ct):
-        ks += hashlib.sha256(
-            key + b"|enc|" + ctx + ctr.to_bytes(4, "big")
-        ).digest()
-        ctr += 1
-    return bytes(a ^ b for a, b in zip(ct, ks))
+    return _keystream_xor(key, ctx, ct)
 
 
 # ---------------------------------------------------------------------------

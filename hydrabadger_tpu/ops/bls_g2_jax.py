@@ -147,12 +147,30 @@ def g2_add(p1: jax.Array, p2: jax.Array) -> jax.Array:
     return res
 
 
-@jax.jit
 def g2_scalar_mul_windowed(points: jax.Array, windows: jax.Array) -> jax.Array:
     """Fixed-window (w=4) ladder, same shape as bls_jax's G1 ladder.
 
     points: [..., 3, 2, 32], windows: [..., 64] MSB-first 4-bit digits.
-    """
+    On TPU this dispatches to the fused fq2_T window-step kernels
+    (whole table builds and 4-dbl+select+add steps as single Mosaic
+    programs); the XLA form below remains the CPU/test path."""
+    if bj._use_mxu():
+        from . import fq2_T
+
+        batch = points.shape[:-3]
+        flat = int(np.prod(batch)) if batch else 1
+        out = fq2_T.g2_scalar_mul_windowed_T(
+            points.reshape(flat, 3, 2, N_LIMBS),
+            windows.reshape(flat, -1),
+        )
+        return out.reshape(*batch, 3, 2, N_LIMBS)
+    return _g2_scalar_mul_windowed_xla(points, windows)
+
+
+@jax.jit
+def _g2_scalar_mul_windowed_xla(
+    points: jax.Array, windows: jax.Array
+) -> jax.Array:
     batch = points.shape[:-3]
 
     def tbl_step(prev, _):

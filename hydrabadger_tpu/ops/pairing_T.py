@@ -26,21 +26,42 @@ import numpy as np
 
 from .bls_jax import N_LIMBS, P_LIMBS
 from .circuit_T import executor
-from .fq_T import PL_COL, _sub_rows
+from .fq_T import PL_COL, _sub_rows, _use_pallas
 from .pairing_jax import (
     X_ABS,
     _ONE12,
     _conj_circuit,
-    _cyc_sqr_circuit,
+    _cyc_sqr_circuit_k,
     _exp_segments,
     _fq_inv,
     _inv_back_circuit,
     _inv_front_circuit,
     _miller_add_circuit,
-    _miller_dbl_circuit,
+    _miller_dbl_circuit_k,
     _mul_circuit,
     _mul_conj_frob_circuit,
 )
+
+# Unroll factors: chained steps recorded into ONE circuit kernel
+# (ceil(run/K) kernels per square-and-multiply run instead of `run`).
+# TPU-only: XLA:CPU compiles the unrolled circuit graphs pathologically
+# (the round-2 lesson), and the CPU twin's correctness coverage doesn't
+# need them — tests pin k-chained == k x single-step algebraically.
+# Overridable for A/B runs via HB_PAIRING_UNROLL="dbl,sqr".
+import os as _os
+
+
+def _unroll_factors():
+    spec = _os.environ.get("HB_PAIRING_UNROLL")
+    if spec:
+        d, s = spec.split(",")
+        return int(d), int(s)
+    if _use_pallas():
+        return 4, 8
+    return 1, 1
+
+
+_DBL_K, _SQR_K = _unroll_factors()
 
 _R12 = 12 * N_LIMBS  # rows of an Fp12 element
 _ONE12_COL = np.ascontiguousarray(_ONE12.reshape(_R12, 1))
@@ -81,24 +102,32 @@ def _fq12_inv_T(f):
     )
 
 
+def _sqr_run_T(acc, n):
+    """n cyclotomic squarings via unrolled kernels: floor(n/K) calls of
+    the K-step circuit (scanned) + one exact-remainder circuit."""
+    if n == 0:
+        return acc
+    whole, rem = divmod(n, _SQR_K)
+    if whole == 1:
+        acc = executor(_cyc_sqr_circuit_k(_SQR_K))(acc)
+    elif whole > 1:
+        big = executor(_cyc_sqr_circuit_k(_SQR_K))
+        acc, _ = jax.lax.scan(
+            lambda c, _: (big(c), None), acc, None, length=whole
+        )
+    if rem:
+        acc = executor(_cyc_sqr_circuit_k(rem))(acc)
+    return acc
+
+
 def _pow_x_abs_T(a):
     """a^|x| in the cyclotomic subgroup (Granger-Scott squarings)."""
-    sqr = executor(_cyc_sqr_circuit())
-
-    def sq_run(acc, n):
-        if n == 0:
-            return acc
-        out, _ = jax.lax.scan(
-            lambda c, _: (sqr(c), None), acc, None, length=n
-        )
-        return out
-
     segs = _exp_segments(X_ABS)
     acc = a
     for run in segs[:-1]:
-        acc = sq_run(acc, run)
+        acc = _sqr_run_T(acc, run)
         acc = _fq12_mul_T(acc, a)
-    return sq_run(acc, segs[-1])
+    return _sqr_run_T(acc, segs[-1])
 
 
 def _cyc_pow_x_T(a):
@@ -137,30 +166,39 @@ def _miller_T(qx, qy, px, py):
         [qx, qy, jnp.broadcast_to(jnp.asarray(one2), (2 * N_LIMBS, b))],
         axis=0,
     )
-    dbl = executor(_miller_dbl_circuit())
     add = executor(_miller_add_circuit())
     r_rows = 6 * N_LIMBS
 
     def pack(f, r):
         return jnp.concatenate([f, r, qx, qy, px, py], axis=0)
 
+    def unpack(out):
+        return out[:_R12], out[_R12 : _R12 + r_rows]
+
     def dbl_run(f, r, n):
+        """n double steps: floor(n/K) unrolled-K kernels (scanned) plus
+        one exact-remainder kernel."""
         if n == 0:
             return f, r
+        whole, rem = divmod(n, _DBL_K)
+        if whole == 1:
+            f, r = unpack(executor(_miller_dbl_circuit_k(_DBL_K))(pack(f, r)))
+        elif whole > 1:
+            big = executor(_miller_dbl_circuit_k(_DBL_K))
 
-        def step(carry, _):
-            ff, rr = carry
-            out = dbl(pack(ff, rr))
-            return (out[:_R12], out[_R12 : _R12 + r_rows]), None
+            def step(carry, _):
+                ff, rr = carry
+                return unpack(big(pack(ff, rr))), None
 
-        (f, r), _ = jax.lax.scan(step, (f, r), None, length=n)
+            (f, r), _ = jax.lax.scan(step, (f, r), None, length=whole)
+        if rem:
+            f, r = unpack(executor(_miller_dbl_circuit_k(rem))(pack(f, r)))
         return f, r
 
     segs = _exp_segments(X_ABS)
     for run in segs[:-1]:
         f, r = dbl_run(f, r, run)
-        out = add(pack(f, r))
-        f, r = out[:_R12], out[_R12 : _R12 + r_rows]
+        f, r = unpack(add(pack(f, r)))
     f, _ = dbl_run(f, r, segs[-1])
     return f
 

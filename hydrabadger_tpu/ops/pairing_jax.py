@@ -203,24 +203,10 @@ def _sparse035(f: S12, a0: S2, a3: S2, a5: S2) -> S12:
     return S12(t0 + t1.mul_v(), t2 - t0 - t1)
 
 
-@lru_cache(maxsize=None)
-def _miller_dbl_circuit():
-    """Inputs: f(12) R(6: X,Y,Z as Fp2 pairs) qx(2) qy(2) px(1) py(1) =
-    24.  Outputs: f_dbl(12), R_dbl(6) — one squaring-and-tangent Miller
-    iteration.  The ate bits are STATIC, so the loop is segmented into
-    runs of these double-only steps with _miller_add_circuit applied
-    once per in-loop set bit (5 of the 63 scanned bits; the 6th set
-    bit of |x| is the implicit leading one) — the round-2 combined circuit paid the
-    chord-and-add lanes on every iteration."""
-    b = CircuitBuilder(24)
-    f = _s12_from_inputs(b, 0)
-    X = _s2_from_inputs(b, 12)
-    Y = _s2_from_inputs(b, 14)
-    Z = _s2_from_inputs(b, 16)
-    px, py = b.input(22), b.input(23)
-
+def _miller_dbl_step(f: "S12", X: "S2", Y: "S2", Z: "S2", px, py):
+    """One squaring-and-tangent Miller iteration on symbols (shared by
+    the single-step and unrolled circuit recorders)."""
     f2 = f.sqr()
-    # tangent line + projective double
     XX = X * X
     YY = Y * Y
     S = Y * Z
@@ -239,9 +225,36 @@ def _miller_dbl_circuit():
     Rd_x = (H * S).dbl()
     Rd_y = W * (B4 - H) - (YY * S2_).dbl().dbl().dbl()
     Rd_z = (S * S2_).dbl().dbl().dbl()
+    return fd, Rd_x, Rd_y, Rd_z
 
-    outs = fd.coeffs() + [*Rd_x.c, *Rd_y.c, *Rd_z.c]
+
+@lru_cache(maxsize=None)
+def _miller_dbl_circuit_k(k: int):
+    """k chained Miller double steps as ONE circuit — the dominant
+    runtime cost on the tunneled TPU is fixed per-pallas-call overhead,
+    so the 63-step loop runs as ceil(63/k) kernels instead of 63."""
+    b = CircuitBuilder(24)
+    f = _s12_from_inputs(b, 0)
+    X = _s2_from_inputs(b, 12)
+    Y = _s2_from_inputs(b, 14)
+    Z = _s2_from_inputs(b, 16)
+    px, py = b.input(22), b.input(23)
+    for _ in range(k):
+        f, X, Y, Z = _miller_dbl_step(f, X, Y, Z, px, py)
+    outs = f.coeffs() + [*X.c, *Y.c, *Z.c]
     return b.compile(outs)
+
+
+@lru_cache(maxsize=None)
+def _miller_dbl_circuit():
+    """Inputs: f(12) R(6: X,Y,Z as Fp2 pairs) qx(2) qy(2) px(1) py(1) =
+    24.  Outputs: f_dbl(12), R_dbl(6) — one squaring-and-tangent Miller
+    iteration.  The ate bits are STATIC, so the loop is segmented into
+    runs of these double-only steps with _miller_add_circuit applied
+    once per in-loop set bit (5 of the 63 scanned bits; the 6th set
+    bit of |x| is the implicit leading one) — the round-2 combined circuit paid the
+    chord-and-add lanes on every iteration."""
+    return _miller_dbl_circuit_k(1)
 
 
 @lru_cache(maxsize=None)
@@ -300,6 +313,49 @@ def _fp4_sqr(x0: S2, x1: S2) -> tuple[S2, S2]:
     return t0 + t1.mul_xi(), s - t0 - t1
 
 
+def _cyc_sqr_step(f: "S12") -> "S12":
+    """One Granger-Scott cyclotomic squaring on symbols."""
+    g0, g1, g2 = f.g.c
+    h0, h1, h2 = f.h.c
+    a20, a21 = _fp4_sqr(g0, h1)
+    b20, b21 = _fp4_sqr(h0, g2)
+    c20, c21 = _fp4_sqr(g1, h2)
+    three = lambda x: x.dbl() + x
+    ng0 = three(a20) - g0.dbl()
+    nh1 = three(a21) + h1.dbl()
+    nh0 = three(c21.mul_xi()) + h0.dbl()
+    ng2 = three(c20) - g2.dbl()
+    ng1 = three(b20) - g1.dbl()
+    nh2 = three(b21) + h2.dbl()
+    return S12(S6(ng0, ng1, ng2), S6(nh0, nh1, nh2))
+
+
+def _reduce12(b: CircuitBuilder, f: "S12") -> "S12":
+    """Reset coefficient masses by multiplying every coord by Montgomery
+    one (montmul(a, R mod p) == a): chaining GS squarings compounds the
+    linear 2*conj terms past the mix-mass cap, so each chained step
+    costs 12 extra value-preserving lanes instead."""
+    one = b.const(R_MONT % P)
+
+    def red6(s6: "S6") -> "S6":
+        return S6(*(S2(c.c[0] * one, c.c[1] * one) for c in s6.c))
+
+    return S12(red6(f.g), red6(f.h))
+
+
+@lru_cache(maxsize=None)
+def _cyc_sqr_circuit_k(k: int):
+    """k chained cyclotomic squarings as ONE circuit (pallas-call count
+    is the dominant final-exp cost on this platform)."""
+    b = CircuitBuilder(12)
+    f = _s12_from_inputs(b, 0)
+    for i in range(k):
+        if i:
+            f = _reduce12(b, f)
+        f = _cyc_sqr_step(f)
+    return b.compile(f.coeffs())
+
+
 @lru_cache(maxsize=None)
 def _cyc_sqr_circuit():
     """Granger-Scott squaring in the cyclotomic subgroup: 18 lanes vs
@@ -311,25 +367,7 @@ def _cyc_sqr_circuit():
       f^2 = (3A^2 - 2conj(A)) + (3 y C^2 + 2conj(B)) w + (3B^2 - 2conj(C)) w^2
     with conj(x0 + x1 y) = x0 - x1 y.  Pinned against the generic
     multiply on genuinely cyclotomic inputs by tests."""
-    b = CircuitBuilder(12)
-    f = _s12_from_inputs(b, 0)
-    g0, g1, g2 = f.g.c
-    h0, h1, h2 = f.h.c
-    a20, a21 = _fp4_sqr(g0, h1)
-    b20, b21 = _fp4_sqr(h0, g2)
-    c20, c21 = _fp4_sqr(g1, h2)
-    three = lambda x: x.dbl() + x
-    # A' = 3A^2 - 2conj(A): (3 a20 - 2 g0, 3 a21 + 2 h1)
-    ng0 = three(a20) - g0.dbl()
-    nh1 = three(a21) + h1.dbl()
-    # B' = 3 y C^2 + 2conj(B): y*(c20 + c21 y) = (xi c21, c20)
-    nh0 = three(c21.mul_xi()) + h0.dbl()
-    ng2 = three(c20) - g2.dbl()
-    # C' = 3B^2 - 2conj(C): (3 b20 - 2 g1, 3 b21 + 2 h2)
-    ng1 = three(b20) - g1.dbl()
-    nh2 = three(b21) + h2.dbl()
-    out = S12(S6(ng0, ng1, ng2), S6(nh0, nh1, nh2))
-    return b.compile(out.coeffs())
+    return _cyc_sqr_circuit_k(1)
 
 
 def _exp_segments(value: int) -> list[int]:

@@ -293,6 +293,54 @@ struct GF {
 };
 const GF gf;
 
+// dst[c] ^= a * src[c] over GF(2^8), vectorized: PSHUFB nibble tables
+// (32 bytes/op under AVX2) with a scalar tail/fallback.  This is the
+// inner loop of every RS encode/decode — at 128 nodes an era-switch
+// epoch moves ~34 MB/node through it, where the scalar log/exp lookup
+// was the measured wall.
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+static void gf_muladd_row(uint8_t* dst, const uint8_t* src, size_t len,
+                          uint8_t a) {
+  if (a == 0) return;
+  size_t c = 0;
+  if (a == 1) {
+    for (; c + 8 <= len; c += 8) {
+      uint64_t d, s;
+      memcpy(&d, dst + c, 8);
+      memcpy(&s, src + c, 8);
+      d ^= s;
+      memcpy(dst + c, &d, 8);
+    }
+    for (; c < len; c++) dst[c] ^= src[c];
+    return;
+  }
+#if defined(__AVX2__)
+  alignas(32) uint8_t lo[32], hi[32];
+  for (int x = 0; x < 16; x++) {
+    lo[x] = gf.mul(a, uint8_t(x));
+    hi[x] = gf.mul(a, uint8_t(x << 4));
+    lo[x + 16] = lo[x];
+    hi[x + 16] = hi[x];
+  }
+  const __m256i vlo = _mm256_load_si256((const __m256i*)lo);
+  const __m256i vhi = _mm256_load_si256((const __m256i*)hi);
+  const __m256i nib = _mm256_set1_epi8(0x0f);
+  for (; c + 32 <= len; c += 32) {
+    __m256i s = _mm256_loadu_si256((const __m256i*)(src + c));
+    __m256i l = _mm256_shuffle_epi8(vlo, _mm256_and_si256(s, nib));
+    __m256i h = _mm256_shuffle_epi8(
+        vhi, _mm256_and_si256(_mm256_srli_epi16(s, 4), nib));
+    __m256i d = _mm256_loadu_si256((const __m256i*)(dst + c));
+    _mm256_storeu_si256((__m256i*)(dst + c),
+                        _mm256_xor_si256(d, _mm256_xor_si256(l, h)));
+  }
+#endif
+  for (; c < len; c++) dst[c] ^= gf.mul(a, src[c]);
+}
+
 // Gauss-Jordan inverse of an k x k GF matrix; returns false if singular.
 bool gf_mat_inv(std::vector<uint8_t>& m, int k) {
   std::vector<uint8_t> inv(k * k, 0);
@@ -363,12 +411,10 @@ struct RsCodec {
     for (int i = 0; i < k; i++)
       memcpy(shards[i].data(), prefixed.data() + i * shard_len, shard_len);
     for (int i = k; i < n; i++) {
-      for (size_t c = 0; c < shard_len; c++) {
-        uint8_t acc = 0;
-        for (int j = 0; j < k; j++)
-          acc ^= gf.mul(mat[i * k + j], prefixed[j * shard_len + c]);
-        shards[i][c] = acc;
-      }
+      uint8_t* dst = shards[i].data();
+      for (int j = 0; j < k; j++)
+        gf_muladd_row(dst, prefixed.data() + j * shard_len, shard_len,
+                      mat[i * k + j]);
     }
     return shards;
   }
@@ -398,12 +444,9 @@ struct RsCodec {
       if (!gf_mat_inv(sub, k)) return false;
       for (int i = 0; i < k; i++) {
         data[i].assign(shard_len, 0);
-        for (size_t c = 0; c < shard_len; c++) {
-          uint8_t acc = 0;
-          for (int r = 0; r < k; r++)
-            acc ^= gf.mul(sub[i * k + r], (*slots[rows[r]])[c]);
-          data[i][c] = acc;
-        }
+        for (int r = 0; r < k; r++)
+          gf_muladd_row(data[i].data(), slots[rows[r]]->data(), shard_len,
+                        sub[i * k + r]);
       }
     }
     Bytes joined;
@@ -571,6 +614,13 @@ struct World {
   std::vector<Hash> roots;                 // interned
   std::map<Hash, int32_t> root_ids;
   uint64_t delivered = 0, faults = 0, rounds_total = 0;
+  // (prop, root) -> decode + split-root verification result, shared
+  // across the n simulated nodes: the check is a pure function of the
+  // Merkle-verified shard set, and the fast tier is adversary-free, so
+  // every node computes the identical result — memoizing turns the
+  // n^2 re-encodes of era-sized payloads (the measured 128-node wall)
+  // into n.
+  std::map<std::pair<int, int32_t>, std::pair<bool, Bytes>> verify_cache;
 
   World(int n_, int f_, std::string sid, std::vector<Bytes> pls, bool shuf,
         uint64_t seed, uint64_t maxm)
@@ -719,27 +769,37 @@ struct World {
   void rbc_try_decode(int me, int prop, int32_t root_id) {
     RbcState& r = nodes[me].rbc[prop];
     if (r.decided) return;
-    std::vector<const Bytes*> slots(n, nullptr);
-    for (int s = 0; s < n; s++) {
-      const Proof* p = r.echos[s];
-      if (p && root_ids.at(p->root) == root_id) slots[p->index] = p->value;
+    auto key = std::make_pair(prop, root_id);
+    auto hit = verify_cache.find(key);
+    if (hit == verify_cache.end()) {
+      std::vector<const Bytes*> slots(n, nullptr);
+      for (int s = 0; s < n; s++) {
+        const Proof* p = r.echos[s];
+        if (p && root_ids.at(p->root) == root_id) slots[p->index] = p->value;
+      }
+      Bytes payload;
+      if (!codec.reconstruct_data(slots, payload)) {
+        // not enough matching shards yet for THIS node: retryable, not
+        // cacheable (matches the pre-cache behavior: fault + retry)
+        faults++;
+        return;
+      }
+      // split-root re-encode check (broadcast.py:174-181): rebuild the
+      // full coding + tree and compare roots
+      auto full = codec.encode_bytes(payload);
+      MerkleTree tree(std::move(full));
+      bool ok = intern_root(tree.root()) == root_id;
+      if (!ok) payload.clear();
+      hit = verify_cache.emplace(key, std::make_pair(ok, std::move(payload)))
+                .first;
     }
-    Bytes payload;
-    if (!codec.reconstruct_data(slots, payload)) {
-      faults++;
-      return;
-    }
-    // split-root re-encode check (broadcast.py:174-181): rebuild the
-    // full coding + tree and compare roots
-    auto full = codec.encode_bytes(payload);
-    MerkleTree tree(std::move(full));
     r.decided = true;
-    if (!(intern_root(tree.root()) == root_id)) {
+    if (!hit->second.first) {
       faults++;
       return;
     }
     r.has_payload = true;
-    r.payload = std::move(payload);
+    r.payload = hit->second.second;  // copy: per-node owned payload
     subset_progress_one(me, prop);
   }
 

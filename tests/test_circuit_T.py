@@ -131,3 +131,38 @@ def test_pairing_eq_T_end_to_end():
         )
     )
     assert got.tolist() == expect
+
+
+@pytest.mark.slow
+def test_unrolled_circuits_match_chained():
+    """k-step Miller-double / cyclotomic-squaring circuits (the TPU
+    unroll path) are bit-equal to k applications of the single-step
+    circuit on the composed oracle path."""
+    import random
+
+    import jax.numpy as jnp
+
+    from hydrabadger_tpu.crypto.bls12_381 import P
+    from hydrabadger_tpu.ops import pairing_jax as pj
+    from hydrabadger_tpu.ops.bls_jax import int_to_limbs
+
+    rng = random.Random(0)
+    x = np.stack(
+        [np.stack([int_to_limbs(rng.randrange(P)) for _ in range(24)])
+         for _ in range(2)]
+    )
+    one = pj._miller_dbl_circuit()
+    cur = x.copy()
+    for _ in range(3):
+        out = np.asarray(one(jnp.asarray(cur)))
+        cur = np.concatenate([out, cur[:, 18:]], axis=1)
+    got = np.asarray(pj._miller_dbl_circuit_k(3)(jnp.asarray(x)))
+    assert (got == cur[:, :18]).all()
+
+    f = np.stack([int_to_limbs(rng.randrange(P)) for _ in range(12)])[None]
+    sq = pj._cyc_sqr_circuit()
+    ref = f.copy()
+    for _ in range(4):
+        ref = np.asarray(sq(jnp.asarray(ref)))
+    got = np.asarray(pj._cyc_sqr_circuit_k(4)(jnp.asarray(f)))
+    assert (got == ref).all()

@@ -67,3 +67,27 @@ def test_pairing_checks_sharded_across_mesh():
 
     mesh = pmesh.make_mesh()
     assert pmesh.pairing_checks_sharded(mesh, checks_per_device=1)
+
+
+@pytest.mark.slow
+def test_broadcast_round_sharded_64node_geometry():
+    """The BASELINE config-3 shape (64 nodes, 22+42 shards) node-sharded
+    across the 8-device mesh — the benchmark geometry, so uneven-split
+    bugs at the real shape surface off-hardware (VERDICT r4 item 6)."""
+    from hydrabadger_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh(8)
+    rng = np.random.default_rng(7)
+    proposals = rng.integers(0, 256, (64, 22, 32)).astype(np.uint8)
+    _, decoded = pmesh.broadcast_round_sharded(proposals, 22, 42, mesh)
+    assert np.array_equal(np.asarray(decoded), proposals)
+
+
+@pytest.mark.slow
+def test_full_crypto_epoch_sharded_64node_geometry():
+    """A 64-node (threshold 21, quorum 22) full-crypto epoch instance-
+    sharded across the mesh — the config-8 benchmark geometry."""
+    from hydrabadger_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh(8)
+    assert pmesh.full_crypto_epoch_sharded(mesh, n_nodes=64, instances=8)

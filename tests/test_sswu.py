@@ -1,0 +1,102 @@
+"""SSWU hash-to-G2 (crypto/sswu.py): algebraic self-checks.
+
+No external KATs exist in this offline image (documented in the module
+docstring), so the pins are structural: the derived iso curve is
+AB != 0, the iso map is a genuine curve homomorphism onto E', outputs
+land in G2, and the whole hash is deterministic and DST-separated.
+"""
+import pytest
+
+from hydrabadger_tpu.crypto import bls12_381 as bls
+from hydrabadger_tpu.crypto import sswu
+from hydrabadger_tpu.crypto.bls12_381 import FQ2
+
+
+def _affine_add(p, q, a_coeff):
+    """Chord-rule affine add on y^2 = x^3 + a x + b (generic points)."""
+    (x1, y1), (x2, y2) = p, q
+    if x1 == x2 and y1 == -y2:
+        return None
+    if p == q:
+        lam = (FQ2([3, 0]) * x1 * x1 + a_coeff) * (y1 + y1).inv()
+    else:
+        lam = (y2 - y1) * (x2 - x1).inv()
+    x3 = lam * lam - x1 - x2
+    y3 = lam * (x1 - x3) - y1
+    return (x3, y3)
+
+
+def _sswu_point(tag: bytes):
+    u = sswu.hash_to_field_fq2(tag, b"SSWU-TEST", 1)[0]
+    return sswu.map_to_curve_sswu(u)
+
+
+def test_iso_curve_ab_nonzero():
+    iso = sswu._iso()
+    assert iso["A2"] != FQ2.zero()
+    assert iso["B2_2"] != FQ2.zero()
+
+
+def test_sswu_outputs_on_iso_curve():
+    iso = sswu._iso()
+    A, B = iso["A2"], iso["B2_2"]
+    for i in range(8):
+        x, y = _sswu_point(b"pt%d" % i)
+        assert y * y == (x * x + A) * x + B
+
+
+def test_iso_map_lands_on_e_prime():
+    for i in range(8):
+        X, Y = sswu.iso_map(*_sswu_point(b"m%d" % i))
+        assert Y * Y == X * X * X + sswu.B2
+
+
+def test_iso_map_is_homomorphism():
+    """The decisive structural check: a degree-3 isogeny respects
+    addition.  psi(P + Q) == psi(P) + psi(Q) on generic points."""
+    iso = sswu._iso()
+    p = _sswu_point(b"hom-a")
+    q = _sswu_point(b"hom-b")
+    s = _affine_add(p, q, iso["A2"])
+    assert s is not None
+    lhs = sswu.iso_map(*s)
+    pp = sswu.iso_map(*p)
+    qq = sswu.iso_map(*q)
+    rhs = _affine_add(pp, qq, FQ2.zero())
+    assert rhs is not None
+    assert lhs[0] == rhs[0] and lhs[1] == rhs[1]
+
+
+def test_hash_deterministic_and_in_subgroup():
+    a = sswu.hash_to_g2_sswu(b"msg")
+    b = sswu.hash_to_g2_sswu(b"msg")
+    assert bls.eq(a, b)
+    assert bls.in_g2_subgroup(a)
+    assert not bls.is_inf(a)
+
+
+def test_hash_domain_and_message_separation():
+    a = sswu.hash_to_g2_sswu(b"msg", b"DST-1")
+    b = sswu.hash_to_g2_sswu(b"msg", b"DST-2")
+    c = sswu.hash_to_g2_sswu(b"msg2", b"DST-1")
+    assert not bls.eq(a, b)
+    assert not bls.eq(a, c)
+
+
+def test_z_satisfies_rfc_criteria():
+    iso = sswu._iso()
+    z = sswu._z()
+    assert z.sqrt() is None  # non-square
+    assert z != FQ2([-1, 0])
+    g_exc = (
+        lambda x: (x * x + iso["A2"]) * x + iso["B2_2"]
+    )(iso["B2_2"] * (z * iso["A2"]).inv())
+    assert g_exc.sqrt() is not None  # exceptional-case totality
+
+
+def test_expand_message_xmd_shape():
+    out = sswu.expand_message_xmd(b"abc", b"DST", 96)
+    assert len(out) == 96
+    # prefix-freedom: different lengths give unrelated prefixes
+    out2 = sswu.expand_message_xmd(b"abc", b"DST", 32)
+    assert out[:32] != out2

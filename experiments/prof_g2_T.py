@@ -1,0 +1,59 @@
+"""G2 ladder on TPU: fused fq2_T vs composed XLA, + oracle check.
+
+python experiments/prof_g2_T.py [B]
+"""
+import random
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from hydrabadger_tpu.crypto import bls12_381 as bls
+from hydrabadger_tpu.ops import bls_g2_jax as g2
+from hydrabadger_tpu.ops import fq2_T
+from hydrabadger_tpu.ops.bls_jax import scalars_to_windows
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+
+
+def main():
+    rng = random.Random(5)
+    # correctness on hardware: 8 lanes vs host oracle
+    pts = [bls.multiply(bls.G2, rng.randrange(1, bls.R)) for _ in range(8)]
+    scalars = [rng.randrange(0, bls.R) for _ in range(8)]
+    arr = jnp.asarray(g2.g2_points_to_limbs(pts))
+    wins = jnp.asarray(scalars_to_windows(scalars))
+    outs = g2.limbs_to_g2_points(np.asarray(fq2_T.g2_scalar_mul_windowed_T(arr, wins)))
+    for pt, s, o in zip(pts, scalars, outs):
+        assert bls.eq(o, bls.multiply(pt, s)), "TPU fused G2 ladder mismatch"
+    print("fused G2 ladder bit-correct vs host oracle on hardware")
+
+    base = g2.g2_points_to_limbs(
+        [bls.multiply(bls.G2, rng.randrange(1, bls.R)) for _ in range(64)]
+    )
+    big = jnp.asarray(np.tile(base, (B // 64 + 1, 1, 1, 1))[:B])
+    wins = jnp.asarray(
+        scalars_to_windows([rng.randrange(0, bls.R) for _ in range(B)])
+    )
+
+    def timed(label, fn, reps=3):
+        np.asarray(fn(big, wins))
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(fn(big, wins))
+            best = min(best, time.perf_counter() - t0)
+        print(f"{label:28s} {best*1e3:8.1f} ms  -> {B/best:8.0f} muls/s")
+        return best
+
+    t_x = timed("composed XLA ladder", g2._g2_scalar_mul_windowed_xla)
+    t_f = timed("fused fq2_T ladder", fq2_T.g2_scalar_mul_windowed_T)
+    print(f"speedup: {t_x/t_f:.2f}x at B={B}")
+
+
+if __name__ == "__main__":
+    main()

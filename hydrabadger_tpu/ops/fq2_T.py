@@ -154,10 +154,16 @@ def _jac2_add_body(p1, p2, consts):
     return tuple(pick(i) for i in range(_N_COORD))
 
 
-def _jac2_inf(b):
-    one = jnp.broadcast_to(
-        jnp.asarray(np.asarray(ONE_MONT, np.int32)[:, None]), (N_LIMBS, b)
-    )
+_ONE_COL = np.asarray(ONE_MONT, np.int32)[:, None]  # [32, 1]
+
+
+def _jac2_inf(b, one_col=None):
+    """Jacobian infinity (Z = 0).  Inside a Pallas kernel the Montgomery
+    one must arrive as an operand ref (`one_col`); outside, the module
+    constant is materialized directly."""
+    if one_col is None:
+        one_col = jnp.asarray(_ONE_COL)
+    one = jnp.broadcast_to(one_col, (N_LIMBS, b))
     zero = jnp.zeros((N_LIMBS, b), jnp.int32)
     return (one, zero, one, zero, zero, zero)
 
@@ -167,12 +173,24 @@ def _jac2_inf(b):
 # ---------------------------------------------------------------------------
 
 
-def _table_body(pt, consts):
+def _table_body(pt, consts, one_col=None):
     """16-entry w=4 table: [inf, P, 2P, ..., 15P] — returns a list of
     _N_COORD arrays, each [16*32, width] row-stacked.  The 14 chained
     adds run as a lax.scan so the add body is compiled ONCE (unrolling
     it made XLA:CPU compile times pathological)."""
     b = pt[0].shape[-1]
+    inf = _jac2_inf(b, one_col)
+    if _use_pallas():
+        # Mosaic cannot lower scan-with-stacked-outputs; its own IR
+        # compiles the unrolled 14-add chain quickly (it is XLA:CPU
+        # that chokes on the unrolled graph)
+        entries = [inf, pt]
+        for _ in range(14):
+            entries.append(_jac2_add_body(entries[-1], pt, consts))
+        return [
+            jnp.concatenate([e[c] for e in entries], axis=0)
+            for c in range(_N_COORD)
+        ]
 
     def step(prev, _):
         nxt = _jac2_add_body(prev, pt, consts)
@@ -180,7 +198,6 @@ def _table_body(pt, consts):
 
     _, chain = jax.lax.scan(step, pt, None, length=14)
     # chain: [14, 6, 32, width] -> per coord [14*32, width]
-    inf = _jac2_inf(b)
     out = []
     for c in range(_N_COORD):
         rows = chain[:, c].reshape(14 * N_LIMBS, b)
@@ -220,8 +237,9 @@ def _pallas_table_call(b: int):
     def kernel(*refs):
         pt = tuple(r[:] for r in refs[:_N_COORD])
         consts = tuple(r[:] for r in refs[_N_COORD : _N_COORD + 5])
-        outs = _table_body(pt, consts)
-        for r, o in zip(refs[_N_COORD + 5 :], outs):
+        one_col = refs[_N_COORD + 5][:]
+        outs = _table_body(pt, consts, one_col)
+        for r, o in zip(refs[_N_COORD + 6 :], outs):
             r[:] = o
 
     return pl.pallas_call(
@@ -235,7 +253,8 @@ def _pallas_table_call(b: int):
             pl.BlockSpec((N_LIMBS, _BLK), lambda i: (0, i))
             for _ in range(_N_COORD)
         ]
-        + [pl.BlockSpec(s, lambda i: (0, 0)) for s in _CONST_SPECS],
+        + [pl.BlockSpec(s, lambda i: (0, 0)) for s in _CONST_SPECS]
+        + [pl.BlockSpec((N_LIMBS, 1), lambda i: (0, 0))],
         out_specs=tuple(
             pl.BlockSpec((16 * N_LIMBS, _BLK), lambda i: (0, i))
             for _ in range(_N_COORD)
@@ -288,7 +307,9 @@ def _pallas_step_call(b: int):
 def _build_table(pt):
     if _use_pallas():
         (arrs, orig_b) = _pad_lanes(pt, _BLK)
-        outs = _pallas_table_call(arrs[0].shape[-1])(*arrs, *_const_args())
+        outs = _pallas_table_call(arrs[0].shape[-1])(
+            *arrs, *_const_args(), jnp.asarray(_ONE_COL)
+        )
         if orig_b != arrs[0].shape[-1]:
             outs = tuple(o[:, :orig_b] for o in outs)
         return list(outs)

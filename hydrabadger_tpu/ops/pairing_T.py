@@ -44,10 +44,11 @@ from .pairing_jax import (
 
 # Unroll factors: chained steps recorded into ONE circuit kernel
 # (ceil(run/K) kernels per square-and-multiply run instead of `run`).
-# TPU-only: XLA:CPU compiles the unrolled circuit graphs pathologically
-# (the round-2 lesson), and the CPU twin's correctness coverage doesn't
-# need them — tests pin k-chained == k x single-step algebraically.
-# Overridable for A/B runs via HB_PAIRING_UNROLL="dbl,sqr".
+# Measured on hardware (round 4): 4,8 vs 1,1 is a wash at batch 1024
+# (2931 vs 2801 shares/s, inside run noise) while multiplying Mosaic
+# compile time, so the default stays single-step; the k-step recorders
+# remain available via HB_PAIRING_UNROLL="dbl,sqr" for hardware where
+# per-kernel dispatch dominates.
 import os as _os
 
 
@@ -56,8 +57,6 @@ def _unroll_factors():
     if spec:
         d, s = spec.split(",")
         return int(d), int(s)
-    if _use_pallas():
-        return 4, 8
     return 1, 1
 
 

@@ -1,0 +1,58 @@
+"""Probe: XLA:CPU compile time of one GLV ladder, scan vs KS carries.
+
+Tests the hypothesis that the multichip dryrun's 5-minute `jit_epoch`
+compiles come from the hundreds of tiny 63-step carry `lax.scan`s (one
+While loop per fq_mul) rather than from the KS bulk-op form the round-2
+note blamed.  Run each mode in a FRESH process (the carry env is read
+at trace time):
+
+  HYDRABADGER_FQ_CARRY=scan python experiments/prof_ladder_compile.py
+  HYDRABADGER_FQ_CARRY=ks   python experiments/prof_ladder_compile.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _use_cpu_platform_if_requested  # noqa: E402
+
+_use_cpu_platform_if_requested()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from hydrabadger_tpu.crypto import bls12_381 as bls  # noqa: E402
+from hydrabadger_tpu.ops import bls_jax as bj  # noqa: E402
+
+mode = os.environ.get("HYDRABADGER_FQ_CARRY", "(default)")
+B = 128
+rng = np.random.default_rng(0)
+scalars = [int(rng.integers(1, 1 << 63)) * 0x9E3779B97F4A7C15 % bls.R for _ in range(B)]
+pts = [bls.mul_sub(bls.G1, int(s) + 1) for s in range(B)]
+lanes = jnp.asarray(bj.points_to_limbs(pts))
+w1, w2 = bj.scalars_to_glv_windows(scalars)
+w1, w2 = jnp.asarray(w1), jnp.asarray(w2)
+
+t0 = time.perf_counter()
+lowered = jax.jit(bj._jac_scalar_mul_glv_xla).lower(lanes, w1, w2)
+t1 = time.perf_counter()
+compiled = lowered.compile()
+t2 = time.perf_counter()
+out = jax.block_until_ready(compiled(lanes, w1, w2))
+t3 = time.perf_counter()
+# correctness spot check lane 0
+got = bj.limbs_to_points(np.asarray(out[:1]))[0]
+want = bls.mul_sub(pts[0], scalars[0])
+ok = bls.eq(got, want)
+print(
+    f"carry={mode}: trace {t1-t0:.1f}s compile {t2-t1:.1f}s "
+    f"run {t3-t2:.2f}s lane0_ok={ok}",
+    flush=True,
+)
+assert ok

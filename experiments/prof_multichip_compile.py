@@ -1,0 +1,76 @@
+"""Where does the multichip dryrun's XLA:CPU compile time go?
+
+MULTICHIP_r04 failed rc=124: `jit_epoch` (FullCryptoTensorSim) took 3m+
+per compile on the 8-virtual-device CPU backend.  This harness times
+trace (jax.jit lower) and compile separately for the epoch graph at the
+dryrun's two geometries, plus scaling probes, so the fix targets the
+real pass instead of a guess.
+
+Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python experiments/prof_multichip_compile.py [--configs small,big]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _use_cpu_platform_if_requested  # noqa: E402
+
+_use_cpu_platform_if_requested()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def time_epoch_compile(n_nodes: int, instances: int, tag: str) -> None:
+    from hydrabadger_tpu.parallel import mesh as pmesh
+    from hydrabadger_tpu.sim.tensor import FullCryptoConfig, FullCryptoTensorSim
+
+    mesh = pmesh.make_mesh(8)
+    t0 = time.perf_counter()
+    cfg = FullCryptoConfig(
+        n_nodes=n_nodes, instances=instances, share_chunks=1
+    )
+    sim = FullCryptoTensorSim(cfg)
+    t1 = time.perf_counter()
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    sim._U = jax.device_put(jax.device_get(sim._U), sharding)
+    args = (sim._U, *sim._sk_w, *sim._lam_w, *sim._m_w)
+    lowered = sim._epoch_fn.lower(*args)
+    t2 = time.perf_counter()
+    compiled = lowered.compile()
+    t3 = time.perf_counter()
+    out = compiled(*args)
+    jax.block_until_ready(out)
+    t4 = time.perf_counter()
+    print(
+        f"[{tag}] n={n_nodes} B={instances}: setup {t1-t0:.1f}s "
+        f"trace {t2-t1:.1f}s compile {t3-t2:.1f}s run {t4-t3:.1f}s",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    which = "small,big"
+    for a in sys.argv[1:]:
+        if a.startswith("--configs"):
+            which = a.split("=", 1)[1]
+    jax.config.update("jax_platforms", "cpu")
+    print(f"devices: {len(jax.devices())} {jax.default_backend()}", flush=True)
+    if "tiny" in which:
+        time_epoch_compile(4, 8, "tiny")
+    if "small" in which:
+        time_epoch_compile(4, 16, "r1-r3 leg")
+    if "mid" in which:
+        time_epoch_compile(16, 8, "mid probe")
+    if "big" in which:
+        time_epoch_compile(64, 8, "r4 leg")

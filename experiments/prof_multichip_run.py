@@ -1,0 +1,69 @@
+"""Is the 64-node mesh full-crypto leg's 550s RUN duplicated work?
+
+If GSPMD all-gathers the lane axis (the chunk reshape merges the
+sharded instance axis away), every virtual device computes all lanes
+and the mesh run costs ~8x a single-device run of the same shapes.
+Compare: single-device epoch run vs the mesh leg.
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python experiments/prof_multichip_run.py [single|mesh]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _use_cpu_platform_if_requested  # noqa: E402
+
+_use_cpu_platform_if_requested()
+
+import jax  # noqa: E402
+
+from hydrabadger_tpu.sim.tensor import FullCryptoConfig, FullCryptoTensorSim  # noqa: E402
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "single"
+cfg = FullCryptoConfig(n_nodes=64, instances=8, share_chunks=1)
+t0 = time.perf_counter()
+sim = FullCryptoTensorSim(cfg)
+if mode == "mesh":
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hydrabadger_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh(8)
+    sim._U = jax.device_put(
+        jax.device_get(sim._U), NamedSharding(mesh, P(mesh.axis_names[0]))
+    )
+t1 = time.perf_counter()
+if mode == "aot":
+    args = (sim._U, *sim._sk_w, *sim._lam_w, *sim._m_w)
+    lowered = sim._epoch_fn.lower(*args)
+    t2 = time.perf_counter()
+    compiled = lowered.compile()
+    t3 = time.perf_counter()
+    out = jax.block_until_ready(compiled(*args))
+    t4 = time.perf_counter()
+    out = jax.block_until_ready(compiled(*args))
+    t5 = time.perf_counter()
+    print(
+        f"mode=aot: setup {t1-t0:.1f}s trace {t2-t1:.1f}s "
+        f"compile {t3-t2:.1f}s run1 {t4-t3:.1f}s run2 {t5-t4:.1f}s "
+        f"ok={bool(out[1])}",
+        flush=True,
+    )
+else:
+    ok = sim.run(1)  # compile + first run
+    t2 = time.perf_counter()
+    ok2 = sim.run(1)  # steady-state run
+    t3 = time.perf_counter()
+    print(
+        f"mode={mode}: setup {t1-t0:.1f}s first(compile+run) {t2-t1:.1f}s "
+        f"steady-run {t3-t2:.1f}s ok={ok and ok2}",
+        flush=True,
+    )

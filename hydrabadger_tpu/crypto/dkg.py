@@ -28,6 +28,8 @@ from __future__ import annotations
 import hashlib
 import hmac as hmac_mod
 from functools import lru_cache
+
+import numpy as np
 from dataclasses import dataclass, field
 from typing import Dict, Generic, Hashable, List, Mapping, Optional, Tuple, TypeVar
 
@@ -200,12 +202,68 @@ class BivarPoly:
         )
 
 
+def _tpu_dkg_enabled(t: int) -> bool:
+    """Batch the per-node commitment folds on the accelerator?
+
+    Opt-in via HYDRABADGER_TPU_DKG=1 (bench/tests), or automatic when
+    jax is ALREADY loaded with a TPU backend and the matrix is big
+    enough to amortize a dispatch.  Never imports jax unprompted — the
+    TCP runtime must not dial the accelerator tunnel as a side effect
+    of handling a key-gen message."""
+    import os
+    import sys
+
+    env = os.environ.get("HYDRABADGER_TPU_DKG", "")
+    if env == "1":
+        return True
+    if env == "0" or "jax" not in sys.modules:
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu" and t >= 16
+    except Exception:  # pragma: no cover
+        return False
+
+
 class BivarCommitment:
     """g1-commitment matrix to a bivariate polynomial."""
 
     def __init__(self, points: List[List[tuple]]):
         self.t = len(points) - 1
         self.points = points
+        # (kind, index) -> folded commitment row/column, filled by
+        # warm_folds: the decoded commitment object is SHARED by every
+        # in-process node (_commitment_cached), so one batched device
+        # fold serves all n row checks (VERDICT r4 ask 4)
+        self._fold_cache: dict = {}
+
+    def warm_folds(self, indices) -> None:
+        """Batch-fold row and column commitments for all `indices` on
+        the accelerator and cache them; point-identical to the native
+        Horner (affine-normalised on the host)."""
+        indices = [int(i) for i in indices]
+        todo = [
+            i for i in indices
+            if ("row", i) not in self._fold_cache
+        ]
+        if not todo:
+            return
+        from ..ops import bls_jax as bj
+        from ..ops import vandermonde_T as vt
+
+        t1 = self.t + 1
+        flat = [p for row in self.points for p in row]
+        C = bj.points_to_limbs(flat).reshape(t1, t1, 3, bj.N_LIMBS)
+        rows = vt.fold_points_batch(C, todo)           # [M, t1, 3, 32]
+        cols = vt.fold_points_batch(
+            np.swapaxes(C, 0, 1), todo
+        )                                              # [M, t1, 3, 32]
+        row_pts = bj.limbs_to_points(rows.reshape(-1, 3, bj.N_LIMBS))
+        col_pts = bj.limbs_to_points(cols.reshape(-1, 3, bj.N_LIMBS))
+        for mi, idx in enumerate(todo):
+            self._fold_cache[("row", idx)] = row_pts[mi * t1:(mi + 1) * t1]
+            self._fold_cache[("col", idx)] = col_pts[mi * t1:(mi + 1) * t1]
 
     def evaluate(self, x: int, y: int) -> tuple:
         acc = infinity(FQ)
@@ -224,6 +282,9 @@ class BivarCommitment:
         x = 0 is simply the first coefficient row."""
         if x == 0:
             return list(self.points[0])
+        cached = self._fold_cache.get(("row", x))
+        if cached is not None:
+            return list(cached)
         fast = _small_fold(
             self.points, x, 0,
             raw96=self.raw96() if native_bls.available() else None,
@@ -245,6 +306,9 @@ class BivarCommitment:
         Folding the y variable once turns every later evaluate(x, y)
         into t+1 scalar muls instead of (t+1)^2 — and the fold itself is
         the native short-Horner when y is a node index."""
+        cached = self._fold_cache.get(("col", y))
+        if cached is not None:
+            return list(cached)
         fast = _small_fold(
             self.points, y, 1,
             raw96=self.raw96() if native_bls.available() else None,
@@ -478,6 +542,15 @@ class SyncKeyGen(Generic[N]):
             return PartOutcome(False, fault="wrong degree")
         if len(part.enc_rows) != len(self.node_ids):
             return PartOutcome(False, fault="wrong row count")
+        if _tpu_dkg_enabled(self.threshold):
+            # one batched device fold of ALL nodes' row/column
+            # commitments, cached on the shared decoded commitment —
+            # the first in-process handler pays, the other n-1 nodes'
+            # checks (and generate()'s column folds) become lookups
+            try:
+                commit.warm_folds(range(1, len(self.node_ids) + 1))
+            except Exception:  # pragma: no cover - fall back to native
+                pass
         row: Optional[List[int]] = None
         fault = None
         raw = _open(

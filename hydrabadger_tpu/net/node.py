@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time as _time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
@@ -210,6 +211,15 @@ class Hydrabadger:
         # handler is duplicate-tolerant, so replay is always safe)
         self._epoch_outbox: deque = deque(maxlen=EPOCH_OUTBOX_MAX)
         self._last_progress_batches = 0
+        # adaptive replay pacing (the r4 soak post-mortem): a fixed 1 s
+        # stall threshold declares EVERY full-crypto epoch (5-12 s on
+        # one core) stalled, and an unpruned outbox makes each replay
+        # re-verify hundreds of stale frames at every receiver — a
+        # quadratic death spiral.  Track epoch-duration EMA + back off.
+        self._epoch_ema_s: Optional[float] = None
+        self._last_progress_t = _time.monotonic()
+        self._replay_backoff = 1.0
+        self._replayed_since_progress = False
         # user/generator contributions awaiting an epoch whose proposal
         # slot is still free (merged, in order, at the next opportunity)
         self._pending_user: deque = deque(maxlen=4096)
@@ -872,6 +882,11 @@ class Hydrabadger:
             # keep the outbox: stragglers behind a healing link still need
             # the transcript (served on their net_state_request gossip)
             self.state = "validator"
+            # start the replay clock at consensus birth, not node
+            # construction — the bootstrap DKG interval must not seed
+            # the epoch-duration EMA (it would inflate the stall
+            # threshold by minutes exactly when replay matters most)
+            self._last_progress_t = _time.monotonic()
             log.info("%s validator: era %d, %d nodes", self.uid,
                      self.cfg.start_epoch, len(node_ids))
             # replay messages that arrived during keygen (state.rs:473-514)
@@ -923,6 +938,7 @@ class Hydrabadger:
             engine=self.cfg.engine,
         )
         self.state = "observer"
+        self._last_progress_t = _time.monotonic()  # see _maybe_finish_keygen
         log.info("%s observer at era %d epoch %d", self.uid, plan.era, plan.epoch)
         pending, self.iom_queue = self.iom_queue, []
         for src, payload in pending:
@@ -943,7 +959,7 @@ class Hydrabadger:
             if tm.target.kind == "nodes":
                 for nid in tm.target.nodes:
                     uid = Uid(bytes(nid))
-                    self._epoch_outbox.append((uid, msg))
+                    self._epoch_outbox.append((self.current_epoch, uid, msg))
                     if not self.peers.wire_to(uid, msg):
                         self._queue_wire_retry(uid, msg)
             else:
@@ -951,7 +967,7 @@ class Hydrabadger:
                 # too — deliberately mirrors the reference, peer.rs:567).
                 # Loss of an in-flight broadcast (socket tie-breaks,
                 # reconnects) is covered by the epoch replay loop.
-                self._epoch_outbox.append((None, msg))
+                self._epoch_outbox.append((self.current_epoch, None, msg))
                 self.peers.wire_to_all(msg)
         for fault in step.fault_log:
             log.debug("fault: %s %s", str(fault.node_id)[:16], fault.kind)
@@ -1000,13 +1016,33 @@ class Hydrabadger:
         if self.keygen_outbox and self.dhb.era != self.cfg.start_epoch:
             # past the bootstrap era: no straggler can use the transcript
             self.keygen_outbox = []
-        # NOTE: the outbox is deliberately NOT cleared here — the same
-        # Step that commits epoch e already recorded our first epoch-e+1
-        # frames (honey_badger._progress replays deferred traffic), and
-        # clearing would exclude exactly those from stall replay.  Stale
-        # frames are harmless to replay (receivers drop concluded-epoch
-        # traffic; handlers are duplicate-tolerant) and the deque's
-        # maxlen bounds memory.
+        # Prune CONCLUDED epochs' frames from the replay outbox (they
+        # are safe but not free: every replayed frame costs a signature
+        # verify at each receiver — the r4 soak death-spiraled on
+        # exactly that).  Entries are epoch-tagged at send time and the
+        # deque is append-ordered, so a front-pop sweep suffices; the
+        # current epoch's (and pipelined successors') frames stay.
+        while self._epoch_outbox and self._epoch_outbox[0][0] < batch.epoch:
+            self._epoch_outbox.popleft()
+        now = _time.monotonic()
+        dt = now - self._last_progress_t
+        # a stalled epoch's duration must not poison the EMA (it would
+        # raise the next stall's threshold): skip samples from intervals
+        # in which the replay loop fired, and clamp the rest so a single
+        # slow epoch cannot push the threshold beyond ~minutes
+        if not self._replayed_since_progress:
+            dt = min(dt, 60.0)
+            self._epoch_ema_s = (
+                dt if self._epoch_ema_s is None
+                else 0.7 * self._epoch_ema_s + 0.3 * dt
+            )
+        self._last_progress_t = now
+        self._replay_backoff = 1.0
+        self._replayed_since_progress = False
+        # (The outbox is pruned, NOT cleared: the same Step that commits
+        # epoch e already recorded our first epoch-e+1 frames — tagged e
+        # at dispatch time, so the `< batch.epoch` sweep keeps them for
+        # stall replay.)
         self.batches.append(batch)
         self._flush_user_contributions()  # the next epoch just opened
         self.current_epoch = batch.epoch + 1
@@ -1196,14 +1232,29 @@ class Hydrabadger:
             if len(self.batches) != self._last_progress_batches:
                 self._last_progress_batches = len(self.batches)
                 continue
+            # Adaptive stall threshold (r4 soak post-mortem): "stalled"
+            # means no progress for clearly longer than this node's own
+            # recent epoch duration — a fixed 1 s threshold misfires on
+            # every full-crypto epoch and the replay traffic itself
+            # (a signature verify per frame per receiver) then starves
+            # consensus.  Exponential backoff while still stalled keeps
+            # a genuinely wedged epoch from flooding the wire either.
+            ema = self._epoch_ema_s or EPOCH_REPLAY_TICK_S
+            threshold = max(3.0 * ema, 2.0 * EPOCH_REPLAY_TICK_S)
+            threshold *= self._replay_backoff
+            if _time.monotonic() - self._last_progress_t < threshold:
+                continue
+            self._replay_backoff = min(self._replay_backoff * 2.0, 16.0)
+            self._replayed_since_progress = True
             frames = list(self._epoch_outbox)
             log.debug(
-                "%s epoch stalled %.1fs: replaying %d frames",
+                "%s epoch stalled %.1fs (ema %.1fs): replaying %d frames",
                 self.uid,
-                EPOCH_REPLAY_TICK_S,
+                _time.monotonic() - self._last_progress_t,
+                ema,
                 len(frames),
             )
-            for target, msg in frames:
+            for _epoch, target, msg in frames:
                 if target is None:
                     self.peers.wire_to_all(msg)
                 elif not self.peers.wire_to(target, msg):

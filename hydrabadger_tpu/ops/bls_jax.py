@@ -225,6 +225,11 @@ def _sub_limbs(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 def _use_ks() -> bool:
+    env = _os.environ.get("HYDRABADGER_FQ_CARRY", "")
+    if env == "ks":
+        return True
+    if env == "scan":
+        return False
     return _use_mxu()
 
 

@@ -314,6 +314,49 @@ def _jac_add_body(x1, y1, z1, x2, y2, z2, consts):
     )
 
 
+def _jac_add_ladder_body(x1, y1, z1, x2, y2, z2, consts):
+    """INCOMPLETE Jacobian add for ladder steps: 16 muls, inf masks,
+    NO doubling arm.  Sound whenever P1 == P2 cannot occur — true for
+    ladder accumulator/table adds with overwhelming probability (a
+    collision implies the accumulated scalar hit the table index mod
+    the 255-bit group order; table chains avoid i=1+1 by an explicit
+    double, see decrypt_T).  The branch-free _jac_add_body (with its
+    always-computed doubling arm, +8 muls) remains the general-purpose
+    add."""
+    p_col = consts[4]
+    mul = lambda u, v: _mul_rows(u, v, consts)
+    add = lambda u, v: _add_rows(u, v, p_col)
+    sub = lambda u, v: _sub_rows(u, v, p_col)
+    z1z1 = mul(z1, z1)
+    z2z2 = mul(z2, z2)
+    u1 = mul(x1, z2z2)
+    u2 = mul(x2, z1z1)
+    s1 = mul(mul(y1, z2), z2z2)
+    s2 = mul(mul(y2, z1), z1z1)
+    h = sub(u2, u1)
+    r = sub(s2, s1)
+    hh = mul(h, h)
+    hhh = mul(h, hh)
+    v = mul(u1, hh)
+    rr = mul(r, r)
+    x3 = sub(sub(rr, hhh), add(v, v))
+    y3 = sub(mul(r, sub(v, x3)), mul(s1, hhh))
+    z3 = mul(mul(z1, z2), h)
+
+    inf1 = _is_zero_rows(z1)
+    inf2 = _is_zero_rows(z2)
+
+    def pick(gen, a1, a2):
+        out = jnp.where(inf2 == 1, a1, gen)
+        return jnp.where(inf1 == 1, a2, out)
+
+    return (
+        pick(x3, x1, x2),
+        pick(y3, y1, y2),
+        pick(z3, z1, z2),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Pallas wrappers (TPU) / direct bodies (CPU)
 # ---------------------------------------------------------------------------
@@ -357,8 +400,6 @@ def _pallas_point_call(n_in: int, n_out: int, kind: str):
     coordinate operands ([32, B] each)."""
     import jax.experimental.pallas as pl
 
-    body = {"dbl": _jac_double_body, "add": _jac_add_body, "mul": None}[kind]
-
     if kind == "mul":
         def kernel(*refs):
             a, b = refs[0][:], refs[1][:]
@@ -372,10 +413,14 @@ def _pallas_point_call(n_in: int, n_out: int, kind: str):
             for r, o in zip(refs[8:], outs):
                 r[:] = o
     else:
+        add_body = (
+            _jac_add_ladder_body if kind == "ladd" else _jac_add_body
+        )
+
         def kernel(*refs):
             coords = [r[:] for r in refs[:6]]
             consts = tuple(r[:] for r in refs[6:11])
-            outs = _jac_add_body(*coords, consts)
+            outs = add_body(*coords, consts)
             for r, o in zip(refs[11:], outs):
                 r[:] = o
 
@@ -437,6 +482,14 @@ def jac_add_T(p1, p2):
     if _use_pallas():
         return _pallas_point_call(6, 3, "add")(*p1, *p2)
     return _jac_add_body(*p1, *p2, _const_args())
+
+
+def jac_add_ladder_T(p1, p2):
+    """Incomplete ladder add (16 muls; no doubling arm) — see
+    _jac_add_ladder_body for the soundness argument."""
+    if _use_pallas():
+        return _pallas_point_call(6, 3, "ladd")(*p1, *p2)
+    return _jac_add_ladder_body(*p1, *p2, _const_args())
 
 
 def jac_infinity_T(b):

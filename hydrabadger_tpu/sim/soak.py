@@ -126,8 +126,21 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
         t0 = time.perf_counter()
         peaks = {"deferred": 0, "future": 0, "retry": 0, "outbox": 0}
         committed = [0] * n
+        last_report = t0
         while min(committed) < epochs:
             await asyncio.sleep(0.5)
+            now = time.perf_counter()
+            if now - last_report > 30.0:
+                # live progress (the r4 run burned 7 h invisibly):
+                # per-node committed counts expose a stalled node, the
+                # rate exposes throughput decay
+                done = min(committed)
+                print(
+                    f"soak progress: {committed} epochs, "
+                    f"{done / (now - t0):.3f} eps, rss {rss_mb():.0f} MB",
+                    flush=True,
+                )
+                last_report = now
             for i, m in enumerate(nodes):
                 committed[i] += len(m.batches)
                 # trim the deliberate history (see sim_soak) and drain

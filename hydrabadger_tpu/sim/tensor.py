@@ -197,6 +197,68 @@ class FullCryptoConfig:
         return (self.n_nodes - 1) // 3
 
 
+def build_full_crypto_epoch(B: int, n: int, t: int, chunks: int):
+    """Un-jitted full-crypto epoch over [B, n] ciphertexts.
+
+    Both pipeline stages run as ONE scanned ladder over S = t+2 lanes
+    per ciphertext:
+        stage 1 scalars: [sk_1 .. sk_q, master+1]
+        stage 2 scalars: [lam_1 .. lam_q, 1]
+    so lane i<q ends as lambda_i*(U*sk_i) and lane q as U*(master+1).
+    The epoch then folds U with the q weighted lanes (U_next = U +
+    combine) and checks U_next equals the check lane — exactly as
+    strong as combine == U*master (adding U is injective).  One ladder
+    + one jac_add instantiation total: the r4 graph inlined three
+    ladders and three adds, which XLA:CPU compiled in minutes
+    (MULTICHIP_r04 rc=124); this form compiles the same crypto several
+    times faster.  Module-level so parallel/mesh.py can wrap the same
+    body in shard_map with a per-device node slice."""
+    import jax as _jax
+
+    from ..ops import bls_jax as bj
+
+    q = t + 1
+    S = q + 1
+    one_w1, one_w2 = bj.scalars_to_glv_windows([1])
+
+    def epoch(U, sk_w1, sk_w2, lam_w1, lam_w2, m_w1, m_w2):
+        W = sk_w1.shape[-1]
+        s1w1 = jnp.concatenate([sk_w1[:q], m_w1], axis=0)  # [S, W]
+        s1w2 = jnp.concatenate([sk_w2[:q], m_w2], axis=0)
+        s2w1 = jnp.concatenate([lam_w1, jnp.asarray(one_w1)], axis=0)
+        s2w2 = jnp.concatenate([lam_w2, jnp.asarray(one_w2)], axis=0)
+        xs1 = jnp.stack([s1w1, s2w1])  # [2, S, W]
+        xs2 = jnp.stack([s1w2, s2w2])
+        lanes0 = jnp.broadcast_to(U[:, :, None], (B, n, S, 3, 32))
+
+        def stage(carry, ws):
+            w1s, w2s = ws  # [S, W]
+            w1b = jnp.broadcast_to(w1s[None, None], (B, n, S, W))
+            w2b = jnp.broadcast_to(w2s[None, None], (B, n, S, W))
+            out = _jax.lax.map(
+                lambda args: bj.jac_scalar_mul_glv(*args),
+                (
+                    carry.reshape(chunks, -1, 3, 32),
+                    w1b.reshape(chunks, -1, W),
+                    w2b.reshape(chunks, -1, W),
+                ),
+            )
+            return out.reshape(B, n, S, 3, 32), None
+
+        lanes, _ = _jax.lax.scan(stage, lanes0, (xs1, xs2))
+        weighted = lanes[:, :, :q]
+        direct = lanes[:, :, q]
+
+        def fold(i, acc):
+            return bj.jac_add(acc, weighted[:, :, i])
+
+        U_next = _jax.lax.fori_loop(0, q, fold, U)
+        ok = jnp.all(_jac_eq(U_next, direct))
+        return U_next, ok
+
+    return epoch
+
+
 class FullCryptoTensorSim:
     """Device-resident threshold-decrypt epochs over [B, N] ciphertexts."""
 
@@ -240,74 +302,60 @@ class FullCryptoTensorSim:
         lw1, lw2 = bj.scalars_to_glv_windows(self._lam)
         self._lam_w = (_jax.device_put(jnp.asarray(lw1)),
                        _jax.device_put(jnp.asarray(lw2)))
-        mw1, mw2 = bj.scalars_to_glv_windows([self._master])
+        # the on-device correctness lane computes U*(master+1) directly:
+        # U_next = U + sum_i lambda_i (U sk_i) must equal it (adding U to
+        # both sides of combine == U*master is injective, so the check
+        # is exactly as strong — and it lets the epoch graph share ONE
+        # ladder and ONE jac_add instantiation; see _build_epoch).
+        mp1 = (self._master + 1) % bls.R
+        assert mp1 != 0, "degenerate master key (master == -1 mod R)"
+        self._mp1 = mp1
+        mw1, mw2 = bj.scalars_to_glv_windows([mp1])
         self._m_w = (_jax.device_put(jnp.asarray(mw1)),
                      _jax.device_put(jnp.asarray(mw2)))
+        S = cfg.threshold + 2  # q quorum lanes + 1 check lane
+        assert (cfg.instances * n * S) % cfg.share_chunks == 0, (
+            "share_chunks must divide instances * n_nodes * (threshold+2)"
+        )
         self._epoch_fn = self._build_epoch()
 
     def _build_epoch(self):
+        import os as _os
+
         import jax as _jax
 
-        from ..ops import bls_jax as bj
-
         cfg = self.cfg
-        B, n, t = cfg.instances, cfg.n_nodes, cfg.threshold
-        q = t + 1
-        chunks = cfg.share_chunks
+        use_t = _os.environ.get("HYDRABADGER_DECRYPT_T", "")
+        if use_t != "0" and (
+            use_t == "1" or _jax.default_backend() == "tpu"
+        ):
+            # TPU engine (ops/decrypt_T): static-digit shared-table
+            # ladders + Straus combine; no chunking needed (tables live
+            # in HBM, Mosaic blocks the lane axis).  Projectively equal
+            # to the generic path; pinned by tests/test_decrypt_T.py.
+            from ..ops import decrypt_T
 
-        @_jax.jit
-        def epoch(U, sk_w1, sk_w2, lam_w1, lam_w2, m_w1, m_w2):
-            # 1. share generation: shares[b, j, i] = U[b, j] * sk_i
-            #    only the quorum's shares are materialised (q per ct):
-            #    lanes = B*n*q, chunked to bound the ladder table
-            Uq = jnp.broadcast_to(U[:, :, None], (B, n, q, 3, 32))
-            lanes = Uq.reshape(B * n * q, 3, 32)
-            w1 = jnp.broadcast_to(sk_w1[None, None, :q], (B, n, q, sk_w1.shape[-1]))
-            w2 = jnp.broadcast_to(sk_w2[None, None, :q], (B, n, q, sk_w2.shape[-1]))
-            w1 = w1.reshape(B * n * q, -1)
-            w2 = w2.reshape(B * n * q, -1)
-            share_lanes = _jax.lax.map(
-                lambda args: bj.jac_scalar_mul_glv(*args),
-                (
-                    lanes.reshape(chunks, -1, 3, 32),
-                    w1.reshape(chunks, -1, w1.shape[-1]),
-                    w2.reshape(chunks, -1, w2.shape[-1]),
-                ),
+            fn = decrypt_T.build_epoch(
+                cfg.instances * cfg.n_nodes,
+                [self._sks[i] for i in self._quorum],
+                list(self._lam),
+                self._mp1,
             )
-            shares = share_lanes.reshape(B, n, q, 3, 32)
-            # 2. combine: weighted sum over the quorum with Lagrange
-            #    coefficients — q more ladders per ct, then q-1 adds
-            lw1 = jnp.broadcast_to(lam_w1[None, None], (B, n, q, lam_w1.shape[-1]))
-            lw2 = jnp.broadcast_to(lam_w2[None, None], (B, n, q, lam_w2.shape[-1]))
-            weighted = _jax.lax.map(
-                lambda args: bj.jac_scalar_mul_glv(*args),
-                (
-                    shares.reshape(chunks, -1, 3, 32),
-                    lw1.reshape(chunks, -1, lw1.shape[-1]),
-                    lw2.reshape(chunks, -1, lw2.shape[-1]),
-                ),
-            ).reshape(B, n, q, 3, 32)
+            B, n = cfg.instances, cfg.n_nodes
 
-            def fold(i, acc):
-                return bj.jac_add(acc, weighted[:, :, i])
+            def epoch(U, *_windows):
+                U_next, ok = fn(U.reshape(B * n, 3, 32))
+                return U_next.reshape(B, n, 3, 32), ok
 
-            combined = _jax.lax.fori_loop(
-                1, q, fold, weighted[:, :, 0]
-            )  # [B, n, 3, 32]
-            # 3. on-device correctness: combined must equal U * master
-            mw1 = jnp.broadcast_to(m_w1[0][None, None], (B, n, m_w1.shape[-1]))
-            mw2 = jnp.broadcast_to(m_w2[0][None, None], (B, n, m_w2.shape[-1]))
-            direct = bj.jac_scalar_mul_glv(
-                U.reshape(B * n, 3, 32),
-                mw1.reshape(B * n, -1),
-                mw2.reshape(B * n, -1),
-            ).reshape(B, n, 3, 32)
-            ok = jnp.all(_jac_eq(combined, direct))
-            # 4. evolve ciphertexts (data-dependent; in-subgroup)
-            U_next = bj.jac_add(U, combined)
-            return U_next, ok
-
-        return epoch
+            return epoch
+        return _jax.jit(
+            build_full_crypto_epoch(
+                cfg.instances,
+                cfg.n_nodes,
+                cfg.threshold,
+                cfg.share_chunks,
+            )
+        )
 
     def run(self, epochs: int) -> bool:
         ok_all = True

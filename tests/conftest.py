@@ -24,6 +24,10 @@ try:
     from jax._src import xla_bridge
 
     xla_bridge._backend_factories.pop("axon", None)
+    # NOTE: do NOT enable jax's persistent compilation cache here — this
+    # jax/XLA:CPU build segfaults inside _compile_and_write_cache when
+    # reusing AOT entries (machine-feature mismatch in the serialized
+    # results; observed as a hard SIGSEGV in the round-5 fast gate).
 except Exception:  # pragma: no cover - jax-less environments still test
     pass
 

@@ -1,0 +1,66 @@
+"""The config-8 decrypt engine (ops/decrypt_T) vs the generic epoch.
+
+The engine must be PROJECTIVELY identical to sim/tensor's generic
+build_full_crypto_epoch — same U_next point, same ok verdict — while
+using static digits, shared tables, incomplete ladder adds, and the
+Straus combine.  Runs on CPU (the fq_T bodies trace as plain XLA off
+TPU); the identical code is the TPU path.
+"""
+import numpy as np
+import pytest
+
+from hydrabadger_tpu.sim import tensor as ts
+
+
+def _mk_sim(monkeypatch, flag):
+    monkeypatch.setenv("HYDRABADGER_DECRYPT_T", flag)
+    return ts.FullCryptoTensorSim(
+        ts.FullCryptoConfig(n_nodes=4, instances=2, seed=3, share_chunks=1)
+    )
+
+
+@pytest.mark.slow
+def test_decrypt_T_epoch_matches_generic(monkeypatch):
+    import jax.numpy as jnp
+
+    from hydrabadger_tpu.ops import bls_jax as bj
+    from hydrabadger_tpu.crypto import bls12_381 as bls
+
+    gen = _mk_sim(monkeypatch, "0")
+    fast = _mk_sim(monkeypatch, "1")
+    # identical seeds -> identical keysets and initial U
+    assert np.array_equal(np.asarray(gen._U), np.asarray(fast._U))
+
+    for _ in range(2):
+        ok_g = gen.run(1)
+        ok_f = fast.run(1)
+        assert ok_g and ok_f
+        # states equal PROJECTIVELY lane by lane (the Straus combine
+        # walks a different Jacobian representative)
+        g_pts = bj.limbs_to_points(np.asarray(gen._U).reshape(-1, 3, 32))
+        f_pts = bj.limbs_to_points(np.asarray(fast._U).reshape(-1, 3, 32))
+        assert all(bls.eq(a, b) for a, b in zip(g_pts, f_pts))
+
+
+@pytest.mark.slow
+def test_decrypt_T_check_is_discriminating(monkeypatch):
+    """The on-device equality is a real check: an engine built with a
+    wrong check scalar (master+2) must report ok=False.  (Corrupting U
+    would NOT trip it — the combine identity holds for any group
+    element — so the check's power is exactly the scalar relation.)"""
+    from hydrabadger_tpu.crypto import bls12_381 as bls
+    from hydrabadger_tpu.ops import decrypt_T
+
+    fast = _mk_sim(monkeypatch, "1")
+    cfg = fast.cfg
+    bad_fn = decrypt_T.build_epoch(
+        cfg.instances * cfg.n_nodes,
+        [fast._sks[i] for i in fast._quorum],
+        list(fast._lam),
+        (fast._mp1 + 1) % bls.R,
+    )
+    import jax.numpy as jnp
+
+    U = jnp.asarray(np.asarray(fast._U)).reshape(-1, 3, 32)
+    _, ok = bad_fn(U)
+    assert not bool(ok)

@@ -85,9 +85,12 @@ def test_broadcast_round_sharded_64node_geometry():
 
 @pytest.mark.slow
 def test_full_crypto_epoch_sharded_64node_geometry():
-    """A 64-node (threshold 21, quorum 22) full-crypto epoch instance-
-    sharded across the mesh — the config-8 benchmark geometry."""
+    """A 64-node (threshold 21, quorum 22) full-crypto epoch NODE-
+    sharded across the mesh under shard_map — the config-8 benchmark
+    geometry at 1/n_dev the ladder work of the instance-sharded form
+    (the dryrun budget fix; instance-sharding itself is covered by
+    test_full_crypto_epoch_sharded_across_mesh)."""
     from hydrabadger_tpu.parallel import mesh as pmesh
 
     mesh = pmesh.make_mesh(8)
-    assert pmesh.full_crypto_epoch_sharded(mesh, n_nodes=64, instances=8)
+    assert pmesh.full_crypto_epoch_node_sharded(mesh, n_nodes=64)

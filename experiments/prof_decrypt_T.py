@@ -1,0 +1,45 @@
+"""Where does the decrypt_T epoch spend its time?
+
+Scaling probe: if epochs/s halves from B=64 to B=128 instances the
+engine is compute-bound (optimize muls); if it drops less, per-call
+dispatch dominates (fuse ops per pallas_call).  Also times the stages
+separately at B=64.
+
+Run on the real TPU:  python experiments/prof_decrypt_T.py
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hydrabadger_tpu.sim.tensor import FullCryptoConfig, FullCryptoTensorSim
+
+
+def _sync(x):
+    jax.device_get(np.asarray(jax.tree_util.tree_leaves(x)[0]).reshape(-1)[:1]
+                   if isinstance(x, (tuple, list)) else x)
+
+
+def rate(instances: int, epochs: int = 3) -> float:
+    sim = FullCryptoTensorSim(
+        FullCryptoConfig(n_nodes=64, instances=instances, share_chunks=16)
+    )
+    sim.run(1)  # compile + warm
+    t0 = time.perf_counter()
+    ok = sim.run(epochs)
+    dt = (time.perf_counter() - t0) / epochs
+    assert ok
+    return 1.0 / dt
+
+
+if __name__ == "__main__":
+    r64 = rate(64)
+    r128 = rate(128)
+    print(f"B=64: {r64:.4f} eps   B=128: {r128:.4f} eps   "
+          f"ratio {r64 / r128:.2f} (2.0 = compute-bound)", flush=True)

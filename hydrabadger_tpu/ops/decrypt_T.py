@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from functools import lru_cache
+
 from ..crypto import bls12_381 as bls
 from .bls_jax import BETA_COL, GLV_LAMBDA, N_LIMBS
 from . import fq_T
@@ -54,9 +56,11 @@ from .fq_T import (
     from_points_BC,
     jac_add_T,
     jac_add_ladder_T,
+    jac_double_k_T,
     jac_double_T,
     jac_infinity_T,
     to_points_BC,
+    window_step_T,
 )
 
 
@@ -128,25 +132,149 @@ def _take(table, idx):
     )
 
 
+# ---------------------------------------------------------------------------
+# Fused window-step circuits (the carry-pass collapse)
+#
+# The composed point kernels spend ~2/3 of their time in Kogge-Stone
+# carry normalization: every fq add/sub pays its own passes.  Recording
+# the window step as an fp12_circuit Circuit folds ALL linear ops into
+# the executor's mix matrices with one Barrett normalize per mul layer
+# (ops/circuit_T — the machinery that took the pairing plane to 11x).
+# Circuits cannot branch, so the infinity cases (zero digit, ladder not
+# yet started) resolve OUTSIDE in glue selects driven by SCALAR flags:
+# the dbl+add circuit returns both the doubled-only and the added
+# accumulator, and the caller picks.
+# ---------------------------------------------------------------------------
+
+
+# the circuits record the SAME formula bodies fq_T executes
+# (fq_T.jac_double_formula / jac_add_core_formula), instantiated over
+# the recorder's Sym operators — the two domains cannot drift
+_SYM_OPS = (
+    lambda a, b: a * b,   # mul
+    lambda a: a * a,      # sqr (the recorder treats it as a mul lane)
+    lambda a, b: a + b,   # add
+    lambda a, b: a - b,   # sub
+)
+
+
+def _sym_dbl(pt):
+    return fq_T.jac_double_formula(*pt, *_SYM_OPS)
+
+
+def _sym_ladd(p1, p2):
+    x3, y3, z3, _h, _r = fq_T.jac_add_core_formula(*p1, *p2, *_SYM_OPS)
+    return (x3, y3, z3)
+
+
+@lru_cache(maxsize=None)
+def _dblk_add_circuit(k: int):
+    """Inputs acc(3), sel(3); outputs (2^k acc + sel)(3), (2^k acc)(3)."""
+    from .circuit_T import executor
+    from .fp12_circuit import CircuitBuilder
+
+    b = CircuitBuilder(6)
+    acc = tuple(b.input(c) for c in range(3))
+    sel = tuple(b.input(3 + c) for c in range(3))
+    for _ in range(k):
+        acc = _sym_dbl(acc)
+    added = _sym_ladd(acc, sel)
+    return executor(b.compile([*added, *acc]))
+
+
+@lru_cache(maxsize=None)
+def _add_circuit():
+    """Inputs acc(3), sel(3); outputs (acc + sel)(3)."""
+    from .circuit_T import executor
+    from .fp12_circuit import CircuitBuilder
+
+    b = CircuitBuilder(6)
+    acc = tuple(b.input(c) for c in range(3))
+    sel = tuple(b.input(3 + c) for c in range(3))
+    return executor(b.compile([*_sym_ladd(acc, sel)]))
+
+
+def _stack(pt):
+    return jnp.concatenate(pt, axis=0)
+
+
+def _unstack(rows, n=1):
+    L = N_LIMBS
+    return [
+        (rows[i * 3 * L : i * 3 * L + L],
+         rows[i * 3 * L + L : i * 3 * L + 2 * L],
+         rows[i * 3 * L + 2 * L : i * 3 * L + 3 * L])
+        for i in range(n)
+    ]
+
+
+def _pick(cond, a, b):
+    """Scalar/bool cond -> per-coordinate select."""
+    return tuple(jnp.where(cond, ac, bc) for ac, bc in zip(a, b))
+
+
+def _use_win_circuit() -> bool:
+    import os
+
+    return os.environ.get("HYDRABADGER_WIN_CIRCUIT", "1") != "0"
+
+
 def _glv_ladder_static(table, table2, d1, d2):
     """Shared-table GLV ladder with static digit arrays.
 
     table/table2: stacked [2^w, 32, B] coordinate triples (plain and
     beta-twisted); d1/d2: [n_win] int32 digit arrays (traced or const).
-    Returns the accumulated point."""
+    Returns the accumulated point.
+
+    Default path: the fused (2^k acc + sel) circuit with glue selects —
+    `started` (has any nonzero digit been folded?) and `digit != 0` are
+    SCALARS, so infinity never reaches the branch-free circuit on a
+    path whose result survives the selects."""
     w_dbl = int(np.log2(table[0].shape[0]))
     b = table[0].shape[-1]
-
-    def step(acc, ds):
-        c1, c2 = ds
-        for _ in range(w_dbl):
-            acc = jac_double_T(acc)
-        acc = jac_add_ladder_T(acc, _take(table, c1))
-        acc = jac_add_ladder_T(acc, _take(table2, c2))
-        return acc, None
-
     acc0 = jac_infinity_T(b)
-    acc, _ = jax.lax.scan(step, acc0, (d1, d2))
+
+    if not _use_win_circuit():
+        def step(acc, ds):
+            c1, c2 = ds
+            acc = window_step_T(
+                acc, _take(table, c1), _take(table2, c2), w_dbl
+            )
+            return acc, None
+
+        acc, _ = jax.lax.scan(step, acc0, (d1, d2))
+        return acc
+
+    circ_da = _dblk_add_circuit(w_dbl)
+    circ_a = _add_circuit()
+
+    def step(carry, ds):
+        acc, started = carry
+        c1, c2 = ds
+        s1 = _take(table, c1)
+        s2 = _take(table2, c2)
+        out = circ_da(jnp.concatenate([_stack(acc), _stack(s1)], axis=0))
+        added, doubled = _unstack(out, 2)
+        nz1 = c1 != 0
+        acc1 = _pick(
+            started,
+            _pick(nz1, added, doubled),
+            _pick(nz1, s1, acc),
+        )
+        started1 = started | nz1
+        out2 = circ_a(jnp.concatenate([_stack(acc1), _stack(s2)], axis=0))
+        added2 = _unstack(out2, 1)[0]
+        nz2 = c2 != 0
+        acc2 = _pick(
+            started1,
+            _pick(nz2, added2, acc1),
+            _pick(nz2, s2, acc1),
+        )
+        return (acc2, started1 | nz2), None
+
+    (acc, _), _ = jax.lax.scan(
+        step, (acc0, jnp.asarray(False)), (d1, d2)
+    )
     return acc
 
 
@@ -210,22 +338,67 @@ def build_epoch(n_ct: int, sks: Sequence[int], lams: Sequence[int],
         tabs_z = tabs[:, 2].reshape(q * (1 << w2), N_LIMBS, -1)
         flat_tab = (tabs_x, tabs_y, tabs_z)
 
-        def straus_step(acc, dcol):
-            for _ in range(w2):
-                acc = jac_double_T(acc)
-
-            def add_i(i, a):
-                return jac_add_ladder_T(
-                    a, _take(flat_tab, i * (1 << w2) + dcol[i])
-                )
-
-            acc = jax.lax.fori_loop(0, q, add_i, acc)
-            return acc, None
-
         acc0 = jac_infinity_T(pt[0].shape[-1])
-        combined, _ = jax.lax.scan(
-            straus_step, acc0, jnp.transpose(lam_d)  # [n_win2, q]
-        )
+        if not _use_win_circuit():
+            def straus_step(acc, dcol):
+                acc = jac_double_k_T(acc, w2)
+
+                def add_i(i, a):
+                    return jac_add_ladder_T(
+                        a, _take(flat_tab, i * (1 << w2) + dcol[i])
+                    )
+
+                acc = jax.lax.fori_loop(0, q, add_i, acc)
+                return acc, None
+
+            combined, _ = jax.lax.scan(
+                straus_step, acc0, jnp.transpose(lam_d)  # [n_win2, q]
+            )
+        else:
+            # fused circuits + scalar-flag glue (see _glv_ladder_static)
+            circ_da2 = _dblk_add_circuit(w2)
+            circ_a2 = _add_circuit()
+
+            def straus_step(carry, dcol):
+                acc, started = carry
+                s0 = _take(flat_tab, dcol[0])
+                out = circ_da2(
+                    jnp.concatenate([_stack(acc), _stack(s0)], axis=0)
+                )
+                added, doubled = _unstack(out, 2)
+                nz = dcol[0] != 0
+                acc = _pick(
+                    started,
+                    _pick(nz, added, doubled),
+                    _pick(nz, s0, acc),
+                )
+                started = started | nz
+
+                def add_i(i, carry2):
+                    a, st = carry2
+                    sel = _take(flat_tab, i * (1 << w2) + dcol[i])
+                    add2 = _unstack(
+                        circ_a2(
+                            jnp.concatenate(
+                                [_stack(a), _stack(sel)], axis=0
+                            )
+                        ),
+                        1,
+                    )[0]
+                    nzi = dcol[i] != 0
+                    a2 = _pick(st, _pick(nzi, add2, a), _pick(nzi, sel, a))
+                    return (a2, st | nzi)
+
+                acc, started = jax.lax.fori_loop(
+                    1, q, add_i, (acc, started)
+                )
+                return (acc, started), None
+
+            (combined, _), _ = jax.lax.scan(
+                straus_step,
+                (acc0, jnp.asarray(False)),
+                jnp.transpose(lam_d),
+            )
         # final add uses the COMPLETE body (U == combined is the
         # legitimate equal-points case when master == 1; branch-free)
         U_next = jac_add_T(pt, combined)

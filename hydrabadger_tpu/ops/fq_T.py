@@ -200,6 +200,47 @@ def _conv_rows(a, b):
     return acc
 
 
+def _sqr_conv_rows(a):
+    """Symmetric schoolbook square: half the off-diagonal MACs of
+    _conv_rows (terms (i,j) and (j,i) computed once and doubled, plus
+    the a_i^2 diagonal).  Worst row mass: 2*16*4095^2 + 4095^2 < 2^30,
+    inside the _carry contract."""
+    zrow = jnp.zeros_like(a[:1])
+    acc = None
+    for i in range(N_LIMBS - 1):
+        tail = a[i + 1 :] * a[i : i + 1]  # [31-i, B] at offset 2i+1
+        before = 2 * i + 1
+        after = 2 * N_LIMBS - before - (N_LIMBS - 1 - i)
+        parts = [
+            jnp.concatenate([zrow] * before, axis=0) if before > 1 else zrow,
+            tail,
+        ]
+        if after:
+            parts.append(
+                jnp.concatenate([zrow] * after, axis=0) if after > 1 else zrow
+            )
+        shifted = jnp.concatenate(parts, axis=0)  # [64, B]
+        acc = shifted if acc is None else acc + shifted
+    acc = acc + acc  # each off-diagonal pair counted once
+    d = a * a
+    diag = jnp.stack([d, jnp.zeros_like(d)], axis=1).reshape(
+        2 * N_LIMBS, a.shape[-1]
+    )  # a_i^2 at even row 2i
+    return acc + diag
+
+
+def _sqr_rows(a, consts):
+    """Montgomery square on [32, B] rows — bit-identical to
+    _mul_rows(a, a) with ~half the variable-conv multiplies."""
+    pinv_ev, pinv_od, pf_ev, pf_od, _ = consts
+    cn = _carry_ks_rows(_sqr_conv_rows(a))  # [64, B]
+    m = _carry_ks_rows(_shared_conv(cn[:N_LIMBS], pinv_ev, pinv_od))
+    t = _carry_ks_rows(cn + _shared_conv(m, pf_ev, pf_od))
+    r = t[N_LIMBS:]
+    d, borrow = _sub_ks_rows(r, consts[4])
+    return jnp.where(borrow == 0, d, r)
+
+
 def _mul_rows_lazy(a, b, consts):
     """Montgomery product on [32, B] rows WITHOUT the final conditional
     subtract: for a, b <= 2p the result is < 1.5p (4p^2 < Rp), which the
@@ -248,20 +289,19 @@ def _is_zero_rows(a):
 # ---------------------------------------------------------------------------
 
 
-def _jac_double_body(x, y, z, consts):
-    """a=0 Jacobian doubling on coordinate rows (7 muls, all in VMEM)."""
-    p_col = consts[4]
-    mul = lambda u, v: _mul_rows(u, v, consts)
-    add = lambda u, v: _add_rows(u, v, p_col)
-    sub = lambda u, v: _sub_rows(u, v, p_col)
-    a = mul(x, x)
-    b = mul(y, y)
-    c = mul(b, b)
+def jac_double_formula(x, y, z, mul, sqr, add, sub):
+    """a=0 Jacobian doubling (dbl-2009-l shape: 5 squares + 2 muls),
+    generic over the op domain — fq_T row lambdas AND the circuit
+    recorder's Sym operators share this ONE body, so the two execution
+    domains cannot drift."""
+    a = sqr(x)
+    b = sqr(y)
+    c = sqr(b)
     t = add(x, b)
-    d = sub(sub(mul(t, t), a), c)
+    d = sub(sub(sqr(t), a), c)
     d = add(d, d)
     e = add(add(a, a), a)
-    f = mul(e, e)
+    f = sqr(e)
     x3 = sub(f, add(d, d))
     c8 = add(c, c)
     c8 = add(c8, c8)
@@ -272,27 +312,49 @@ def _jac_double_body(x, y, z, consts):
     return x3, y3, z3
 
 
-def _jac_add_body(x1, y1, z1, x2, y2, z2, consts):
-    """Branch-free Jacobian add (16 muls + doubling arm, in VMEM)."""
-    p_col = consts[4]
-    mul = lambda u, v: _mul_rows(u, v, consts)
-    add = lambda u, v: _add_rows(u, v, p_col)
-    sub = lambda u, v: _sub_rows(u, v, p_col)
-    z1z1 = mul(z1, z1)
-    z2z2 = mul(z2, z2)
+def jac_add_core_formula(x1, y1, z1, x2, y2, z2, mul, sqr, add, sub):
+    """General Jacobian add core (12 muls + 4 squares), NO case
+    handling — callers layer inf masks / doubling arms / glue selects
+    per their domain."""
+    z1z1 = sqr(z1)
+    z2z2 = sqr(z2)
     u1 = mul(x1, z2z2)
     u2 = mul(x2, z1z1)
     s1 = mul(mul(y1, z2), z2z2)
     s2 = mul(mul(y2, z1), z1z1)
     h = sub(u2, u1)
     r = sub(s2, s1)
-    hh = mul(h, h)
+    hh = sqr(h)
     hhh = mul(h, hh)
     v = mul(u1, hh)
-    rr = mul(r, r)
+    rr = sqr(r)
     x3 = sub(sub(rr, hhh), add(v, v))
     y3 = sub(mul(r, sub(v, x3)), mul(s1, hhh))
     z3 = mul(mul(z1, z2), h)
+    return x3, y3, z3, h, r
+
+
+def _row_ops(consts):
+    p_col = consts[4]
+    return (
+        lambda u, v: _mul_rows(u, v, consts),
+        lambda u: _sqr_rows(u, consts),
+        lambda u, v: _add_rows(u, v, p_col),
+        lambda u, v: _sub_rows(u, v, p_col),
+    )
+
+
+def _jac_double_body(x, y, z, consts):
+    """a=0 Jacobian doubling on coordinate rows, all in VMEM."""
+    return jac_double_formula(x, y, z, *_row_ops(consts))
+
+
+def _jac_add_body(x1, y1, z1, x2, y2, z2, consts):
+    """Branch-free Jacobian add (12 muls + 4 squares + doubling arm,
+    in VMEM)."""
+    x3, y3, z3, h, r = jac_add_core_formula(
+        x1, y1, z1, x2, y2, z2, *_row_ops(consts)
+    )
 
     dx, dy, dz = _jac_double_body(x1, y1, z1, consts)
 
@@ -323,25 +385,9 @@ def _jac_add_ladder_body(x1, y1, z1, x2, y2, z2, consts):
     double, see decrypt_T).  The branch-free _jac_add_body (with its
     always-computed doubling arm, +8 muls) remains the general-purpose
     add."""
-    p_col = consts[4]
-    mul = lambda u, v: _mul_rows(u, v, consts)
-    add = lambda u, v: _add_rows(u, v, p_col)
-    sub = lambda u, v: _sub_rows(u, v, p_col)
-    z1z1 = mul(z1, z1)
-    z2z2 = mul(z2, z2)
-    u1 = mul(x1, z2z2)
-    u2 = mul(x2, z1z1)
-    s1 = mul(mul(y1, z2), z2z2)
-    s2 = mul(mul(y2, z1), z1z1)
-    h = sub(u2, u1)
-    r = sub(s2, s1)
-    hh = mul(h, h)
-    hhh = mul(h, hh)
-    v = mul(u1, hh)
-    rr = mul(r, r)
-    x3 = sub(sub(rr, hhh), add(v, v))
-    y3 = sub(mul(r, sub(v, x3)), mul(s1, hhh))
-    z3 = mul(mul(z1, z2), h)
+    x3, y3, z3, _h, _r = jac_add_core_formula(
+        x1, y1, z1, x2, y2, z2, *_row_ops(consts)
+    )
 
     inf1 = _is_zero_rows(z1)
     inf2 = _is_zero_rows(z2)
@@ -399,6 +445,7 @@ def _pallas_point_call(n_in: int, n_out: int, kind: str):
     """Build a pallas_call for a point-op kernel with n_in/n_out
     coordinate operands ([32, B] each)."""
     import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
 
     if kind == "mul":
         def kernel(*refs):
@@ -411,6 +458,33 @@ def _pallas_point_call(n_in: int, n_out: int, kind: str):
             consts = tuple(r[:] for r in refs[3:8])
             outs = _jac_double_body(*coords, consts)
             for r, o in zip(refs[8:], outs):
+                r[:] = o
+    elif kind.startswith("dblk"):
+        k = int(kind[4:])
+
+        def kernel(*refs):
+            pt = tuple(r[:] for r in refs[:3])
+            consts = tuple(r[:] for r in refs[3:8])
+            for _ in range(k):
+                pt = _jac_double_body(*pt, consts)
+            for r, o in zip(refs[8:], pt):
+                r[:] = o
+    elif kind.startswith("win"):
+        # one whole GLV-ladder window — k doublings + the two
+        # dual-table adds — as a single VMEM-resident kernel: the
+        # accumulator never round-trips HBM inside a window
+        k = int(kind[3:])
+
+        def kernel(*refs):
+            acc = tuple(r[:] for r in refs[:3])
+            s1 = tuple(r[:] for r in refs[3:6])
+            s2 = tuple(r[:] for r in refs[6:9])
+            consts = tuple(r[:] for r in refs[9:14])
+            for _ in range(k):
+                acc = _jac_double_body(*acc, consts)
+            acc = _jac_add_ladder_body(*acc, *s1, consts)
+            acc = _jac_add_ladder_body(*acc, *s2, consts)
+            for r, o in zip(refs[14:], acc):
                 r[:] = o
     else:
         add_body = (
@@ -446,6 +520,11 @@ def _pallas_point_call(n_in: int, n_out: int, kind: str):
             out_specs=tuple(
                 pl.BlockSpec((N_LIMBS, _BLK), lambda i: (0, i))
                 for _ in range(n_out)
+            ),
+            # the fused window kernels hold a whole window's wires in
+            # VMEM; Mosaic's 16 MiB default is a fraction of the chip
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024
             ),
         )(*arrs, *_const_args())
         outs = out if isinstance(out, (tuple, list)) else (out,)
@@ -490,6 +569,28 @@ def jac_add_ladder_T(p1, p2):
     if _use_pallas():
         return _pallas_point_call(6, 3, "ladd")(*p1, *p2)
     return _jac_add_ladder_body(*p1, *p2, _const_args())
+
+
+def jac_double_k_T(pt, k: int):
+    """k successive doublings in one kernel (accumulator stays in VMEM)."""
+    if _use_pallas():
+        return _pallas_point_call(3, 3, f"dblk{k}")(*pt)
+    c = _const_args()
+    for _ in range(k):
+        pt = _jac_double_body(*pt, c)
+    return pt
+
+
+def window_step_T(acc, sel1, sel2, k: int):
+    """One GLV dual-table ladder window (k doublings + two incomplete
+    adds) fused into a single kernel."""
+    if _use_pallas():
+        return _pallas_point_call(9, 3, f"win{k}")(*acc, *sel1, *sel2)
+    c = _const_args()
+    for _ in range(k):
+        acc = _jac_double_body(*acc, c)
+    acc = _jac_add_ladder_body(*acc, *sel1, c)
+    return _jac_add_ladder_body(*acc, *sel2, c)
 
 
 def jac_infinity_T(b):

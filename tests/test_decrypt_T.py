@@ -20,12 +20,17 @@ def _mk_sim(monkeypatch, flag):
 
 
 @pytest.mark.slow
-def test_decrypt_T_epoch_matches_generic(monkeypatch):
+@pytest.mark.parametrize("win_circuit", ["1", "0"])
+def test_decrypt_T_epoch_matches_generic(monkeypatch, win_circuit):
+    """Both engine paths — the fused window circuits (default) and the
+    HYDRABADGER_WIN_CIRCUIT=0 composed-kernel escape hatch — must match
+    the generic epoch projectively."""
     import jax.numpy as jnp
 
     from hydrabadger_tpu.ops import bls_jax as bj
     from hydrabadger_tpu.crypto import bls12_381 as bls
 
+    monkeypatch.setenv("HYDRABADGER_WIN_CIRCUIT", win_circuit)
     gen = _mk_sim(monkeypatch, "0")
     fast = _mk_sim(monkeypatch, "1")
     # identical seeds -> identical keysets and initial U

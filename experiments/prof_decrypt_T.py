@@ -9,21 +9,13 @@ Run on the real TPU:  python experiments/prof_decrypt_T.py
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
-
-import jax
-import jax.numpy as jnp
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hydrabadger_tpu.sim.tensor import FullCryptoConfig, FullCryptoTensorSim
-
-
-def _sync(x):
-    jax.device_get(np.asarray(jax.tree_util.tree_leaves(x)[0]).reshape(-1)[:1]
-                   if isinstance(x, (tuple, list)) else x)
 
 
 def rate(instances: int, epochs: int = 3) -> float:

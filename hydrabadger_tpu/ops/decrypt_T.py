@@ -213,6 +213,24 @@ def _pick(cond, a, b):
     return tuple(jnp.where(cond, ac, bc) for ac, bc in zip(a, b))
 
 
+def _glue_add(started, nz, added, sel, prev, doubled=None):
+    """Resolve one conditional table add OUTSIDE the branch-free
+    circuit: -> (next acc, next started).
+
+    started & nz     -> `added` (the circuit's result is valid);
+    started & !nz    -> `doubled` if given (the window's doublings
+                        still apply) else `prev`;
+    !started & nz    -> the selected entry itself (first fold);
+    !started & !nz   -> `prev` (the clean infinity representative —
+                        never the circuit's doubled output, whose x/y
+                        are garbage off the z=0 lane)."""
+    base = doubled if doubled is not None else prev
+    return (
+        _pick(started, _pick(nz, added, base), _pick(nz, sel, prev)),
+        started | nz,
+    )
+
+
 def _use_win_circuit() -> bool:
     import os
 
@@ -255,22 +273,13 @@ def _glv_ladder_static(table, table2, d1, d2):
         s2 = _take(table2, c2)
         out = circ_da(jnp.concatenate([_stack(acc), _stack(s1)], axis=0))
         added, doubled = _unstack(out, 2)
-        nz1 = c1 != 0
-        acc1 = _pick(
-            started,
-            _pick(nz1, added, doubled),
-            _pick(nz1, s1, acc),
+        acc1, started1 = _glue_add(
+            started, c1 != 0, added, s1, acc, doubled
         )
-        started1 = started | nz1
         out2 = circ_a(jnp.concatenate([_stack(acc1), _stack(s2)], axis=0))
         added2 = _unstack(out2, 1)[0]
-        nz2 = c2 != 0
-        acc2 = _pick(
-            started1,
-            _pick(nz2, added2, acc1),
-            _pick(nz2, s2, acc1),
-        )
-        return (acc2, started1 | nz2), None
+        acc2, started2 = _glue_add(started1, c2 != 0, added2, s2, acc1)
+        return (acc2, started2), None
 
     (acc, _), _ = jax.lax.scan(
         step, (acc0, jnp.asarray(False)), (d1, d2)
@@ -366,13 +375,9 @@ def build_epoch(n_ct: int, sks: Sequence[int], lams: Sequence[int],
                     jnp.concatenate([_stack(acc), _stack(s0)], axis=0)
                 )
                 added, doubled = _unstack(out, 2)
-                nz = dcol[0] != 0
-                acc = _pick(
-                    started,
-                    _pick(nz, added, doubled),
-                    _pick(nz, s0, acc),
+                acc, started = _glue_add(
+                    started, dcol[0] != 0, added, s0, acc, doubled
                 )
-                started = started | nz
 
                 def add_i(i, carry2):
                     a, st = carry2
@@ -385,9 +390,7 @@ def build_epoch(n_ct: int, sks: Sequence[int], lams: Sequence[int],
                         ),
                         1,
                     )[0]
-                    nzi = dcol[i] != 0
-                    a2 = _pick(st, _pick(nzi, add2, a), _pick(nzi, sel, a))
-                    return (a2, st | nzi)
+                    return _glue_add(st, dcol[i] != 0, add2, sel, a)
 
                 acc, started = jax.lax.fori_loop(
                     1, q, add_i, (acc, started)

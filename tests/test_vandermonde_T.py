@@ -18,7 +18,7 @@ def test_warm_folds_matches_native_folds():
     cols = {i: commit.column_commitment(i) for i in idxs}
 
     warm = dkg.BivarCommitment(commit.points)
-    warm.warm_folds(idxs)
+    warm.warm_folds(idxs, kinds=("row", "col"))
     for i in idxs:
         got_r = warm.row_commitment(i)
         got_c = warm.column_commitment(i)

@@ -40,7 +40,7 @@ import io
 import os
 import pickle
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from .consensus.dynamic_honey_badger import DynamicHoneyBadger
 from .consensus.types import NetworkInfo

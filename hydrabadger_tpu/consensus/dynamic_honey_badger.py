@@ -33,7 +33,7 @@ from dataclasses import dataclass
 from typing import Dict, Hashable, List, Optional, Tuple, TypeVar
 
 from ..crypto.dkg import Ack, Part, SyncKeyGen
-from ..crypto.threshold import PublicKey, PublicKeySet, SecretKey, SecretKeyShare
+from ..crypto.threshold import PublicKey, PublicKeySet, SecretKey
 from ..utils import codec
 from .honey_badger import Batch, HoneyBadger
 from .types import NetworkInfo, Step, guarded_handler

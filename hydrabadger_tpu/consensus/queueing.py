@@ -9,7 +9,7 @@ the queue), and committed transactions are pruned everywhere.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Hashable, List, Optional, TypeVar
+from typing import Hashable, List, TypeVar
 
 from ..utils import codec
 from .honey_badger import Batch, HoneyBadger

@@ -34,11 +34,9 @@ from dataclasses import dataclass, field
 from typing import Dict, Generic, Hashable, List, Mapping, Optional, Tuple, TypeVar
 
 from ..utils import codec
-from . import bls12_381 as bls
 from . import native_bls
-from .bls12_381 import FQ, G1, R, add, eq, g1_from_bytes, g1_to_bytes, infinity, mul_sub, multiply
+from .bls12_381 import FQ, G1, R, add, eq, g1_from_bytes, g1_to_bytes, infinity, mul_sub
 from .threshold import (
-    Ciphertext,
     PublicKey,
     PublicKeySet,
     SecretKey,

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
-from typing import Iterable, Mapping, Optional, Sequence
+from typing import Mapping, Optional, Sequence
 
 from ..utils.lru import DigestLRU
 
@@ -42,11 +42,8 @@ from .bls12_381 import (
     g2_to_bytes,
     hash_to_g2,
     infinity,
-    is_inf,
     mul_sub,
     multiply,
-    neg,
-    normalize,
     pairing_check_eq,
 )
 

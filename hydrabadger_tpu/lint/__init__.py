@@ -1,0 +1,176 @@
+"""hblint — repo-native static analysis for the three load-bearing contracts.
+
+The codebase runs on contracts that exist only as prose, and one silent
+violation corrupts consensus safety or TPU lowering:
+
+  * **sans-io** — consensus cores never touch sockets, clocks or ambient
+    randomness (consensus/types.py module docstring); all effects flow
+    through Steps and explicit rng arguments.
+  * **Mosaic** — transposed kernels honor the Mosaic lowering
+    constraints: no strided tensor slices, no bool vectors, no
+    dynamic_slice (ops/fq_T.py module docstring).
+  * **jit hygiene** — no host round-trips (`float()` / `int()` /
+    `np.asarray` / `.item()` / `.tolist()`) of traced values inside
+    `@jax.jit` / `pallas_call` regions.
+  * **limb layout** — field elements are int32 ``[32, B]`` limb arrays;
+    the named constants ``N_LIMBS`` / ``LIMB_BITS`` / ``LIMB_MASK``
+    are used instead of magic literals, and no float dtype ever enters
+    a field plane.
+  * **wire exhaustiveness** — every wire message kind is declared in
+    ``net/wire.py:KINDS``, constructed somewhere in the network plane,
+    and dispatched in ``net/node.py`` / ``net/peer.py``.  (The decode
+    side is generic — ``utils/codec.py`` is self-describing — so decode
+    coverage is pinned by the paired runtime round-trip test in
+    ``tests/test_codec.py`` via :func:`lint.wire_contract.sample_messages`.)
+
+Run with ``python -m hydrabadger_tpu.lint``; exits nonzero on any
+unsuppressed finding and prints ``file:line: rule: message`` diagnostics.
+
+Suppression syntax (per line, justification MANDATORY)::
+
+    expr  # hblint: disable=<rule> -- <why this is sound>
+
+A suppression comment may also stand alone on the line directly above
+the flagged statement.  A ``disable=`` without a justification is itself
+reported (rule ``suppression``).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hblint:\s*disable=([\w][\w,\s-]*?)\s*(?:--\s*(.*\S))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: a contract violation at a specific line."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+class SourceFile:
+    """A parsed module plus the path metadata rules scope on."""
+
+    def __init__(self, path: Path, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath  # posix path relative to the package root
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=str(path))
+
+    @classmethod
+    def load(cls, path: Path, root: Path = PACKAGE_ROOT) -> "SourceFile":
+        relpath = path.resolve().relative_to(root.resolve()).as_posix()
+        return cls(path, relpath, path.read_text())
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        # render paths package-qualified so diagnostics are clickable
+        # from the repo root
+        shown = (Path(PACKAGE_ROOT.name) / self.relpath).as_posix()
+        return Finding(rule=rule, path=shown, line=line, message=message)
+
+
+def _suppressions(sf: SourceFile) -> Tuple[Dict[int, set], List[Finding]]:
+    """Map line -> suppressed rule names; malformed pragmas are findings."""
+    by_line: Dict[int, set] = {}
+    bad: List[Finding] = []
+    for i, raw in enumerate(sf.lines, start=1):
+        if "hblint" not in raw:
+            continue
+        m = _SUPPRESS_RE.search(raw)
+        if m is None:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        justification = m.group(2)
+        if not justification:
+            bad.append(
+                Finding(
+                    rule="suppression",
+                    path=(Path(PACKAGE_ROOT.name) / sf.relpath).as_posix(),
+                    line=i,
+                    message=(
+                        "suppression without a justification — write "
+                        "`# hblint: disable=<rule> -- <why this is sound>`"
+                    ),
+                )
+            )
+            continue
+        target = i + 1 if raw.lstrip().startswith("#") else i
+        by_line.setdefault(target, set()).update(rules)
+    return by_line, bad
+
+
+def all_rules():
+    """The rule registry, in report order."""
+    from . import deadcode, jit_hygiene, limb_layout, mosaic, sansio
+    from . import wire_contract
+
+    return [sansio, mosaic, jit_hygiene, limb_layout, wire_contract, deadcode]
+
+
+def iter_sources(root: Path = PACKAGE_ROOT) -> Iterable[SourceFile]:
+    for path in sorted(root.rglob("*.py")):
+        yield SourceFile.load(path, root)
+
+
+def run(
+    root: Path = PACKAGE_ROOT,
+    rules: Optional[Sequence] = None,
+    files: Optional[Sequence[Path]] = None,
+) -> Tuple[List[Finding], int]:
+    """Run ``rules`` over ``root`` (or explicit ``files``).
+
+    Returns ``(unsuppressed findings, suppressed count)``.
+    """
+    selected = list(rules) if rules is not None else all_rules()
+    sources = (
+        [SourceFile.load(Path(f), root) for f in files]
+        if files is not None
+        else list(iter_sources(root))
+    )
+    findings: List[Finding] = []
+    suppressed = 0
+    for sf in sources:
+        by_line, bad = _suppressions(sf)
+        findings.extend(bad)
+        for rule in selected:
+            applies = getattr(rule, "applies", None)
+            if applies is not None and not applies(sf.relpath):
+                continue
+            for f in rule.check(sf):
+                if rule.RULE in by_line.get(f.line, ()):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, suppressed
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,68 @@
+"""CLI runner: ``python -m hydrabadger_tpu.lint [options] [files...]``.
+
+Exits 0 when every finding is suppressed-with-justification or absent;
+nonzero otherwise.  Diagnostics are ``file:line: rule: message``.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import PACKAGE_ROOT, all_rules, run
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hydrabadger_tpu.lint",
+        description="repo-native static analysis for the sans-io, Mosaic, "
+        "jit-hygiene, limb-layout and wire-exhaustiveness contracts",
+    )
+    parser.add_argument(
+        "files",
+        nargs="*",
+        help="specific files to lint (default: the whole package)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        help="run only this rule (repeatable); see --list-rules",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="suppress the summary line"
+    )
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rule in rules:
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.RULE:18s} {doc}")
+        return 0
+    if args.rule:
+        known = {r.RULE: r for r in rules}
+        unknown = [r for r in args.rule if r not in known]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        rules = [known[r] for r in args.rule]
+
+    files = [Path(f) for f in args.files] or None
+    findings, suppressed = run(rules=rules, files=files)
+    for f in findings:
+        print(f.render())
+    if not args.quiet:
+        noun = "finding" if len(findings) == 1 else "findings"
+        print(
+            f"hblint: {len(findings)} {noun} "
+            f"({suppressed} suppressed with justification) across "
+            f"{len(rules)} rule(s) in {PACKAGE_ROOT.name}/"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,113 @@
+"""Rule ``jit-hygiene``: no host round-trips inside traced regions.
+
+``float()`` / ``int()`` / ``np.asarray`` / ``.item()`` / ``.tolist()``
+on a traced value either raises a ConcretizationTypeError at trace time
+or — worse — silently bakes a single traced value into a constant and
+forces a device→host sync per call.  Inside ``@jax.jit`` functions and
+Pallas kernel bodies these coercions are never what production code
+wants.
+
+Flags, under ``ops/`` and ``crypto/``, inside traced regions only:
+
+  * a *traced region* is the body of any function decorated with
+    ``jax.jit`` / ``jit`` / ``partial(jax.jit, ...)``, or any function
+    passed as the kernel argument to ``pl.pallas_call`` (tracked by
+    name within the module, including nested defs);
+  * flagged calls: ``float()``, ``int()``, ``np.asarray``, ``np.array``,
+    ``.item()``, ``.tolist()``, ``jax.device_get``,
+    ``.block_until_ready()``.
+
+Static-shape arithmetic (e.g. ``int(np.prod(shape))`` on a Python
+tuple) is legitimate inside a jit function — suppress those with a
+justification naming the static value.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from . import Finding, SourceFile, dotted_name
+
+RULE = "jit-hygiene"
+
+_BANNED_NAME_CALLS = frozenset({"float", "int"})
+_BANNED_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+_BANNED_DOTTED = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+     "jax.device_get"}
+)
+
+
+def applies(relpath: str) -> bool:
+    return relpath.startswith("ops/") or relpath.startswith("crypto/")
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    dn = dotted_name(dec)
+    if dn in ("jax.jit", "jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn in ("jax.jit", "jit"):
+            return True
+        if fn in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+def _kernel_names(tree: ast.AST) -> Set[str]:
+    """Names of functions passed as the kernel arg to pl.pallas_call."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func) or ""
+            if fn.rsplit(".", 1)[-1] == "pallas_call" and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    names.add(first.id)
+    return names
+
+
+def _traced_regions(tree: ast.AST) -> List[ast.FunctionDef]:
+    kernels = _kernel_names(tree)
+    regions = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in kernels or any(
+                _is_jit_decorator(d) for d in node.decorator_list
+            ):
+                regions.append(node)
+    return regions
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    seen = set()  # a kernel nested in a jit fn must be flagged once
+    for region in _traced_regions(sf.tree):
+        for node in ast.walk(region):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            dn = dotted_name(node.func)
+            msg = None
+            if dn in _BANNED_NAME_CALLS:
+                msg = (
+                    f"{dn}() inside traced region {region.name!r} — "
+                    "coercing a traced value concretizes it"
+                )
+            elif dn in _BANNED_DOTTED:
+                msg = (
+                    f"{dn} inside traced region {region.name!r} — host "
+                    "round-trip of a traced value"
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BANNED_METHODS
+            ):
+                msg = (
+                    f".{node.func.attr}() inside traced region "
+                    f"{region.name!r} — device→host sync per call"
+                )
+            if msg is not None:
+                out.append(sf.finding(RULE, node, msg))
+    return out

@@ -1,0 +1,149 @@
+"""Rule ``limb-layout``: int32 ``[32, B]`` limbs, named constants only.
+
+Contract (ops/bls_jax.py, ops/fq_T.py): a field element is an int32
+limb array — 32 limbs of 12 bits (``N_LIMBS`` / ``LIMB_BITS`` /
+``LIMB_MASK``) — and every kernel plane stays integer end to end.  A
+float dtype anywhere in a field plane silently rounds 381-bit
+arithmetic; a magic ``4095`` or ``>> 12`` that drifts from the named
+constants corrupts every limb it touches if the layout is ever
+retuned.
+
+Flags, in ``ops/`` modules that reference the limb constants (the
+"field planes"), plus dtype checks in every ``ops/*_T.py``:
+
+  * float dtypes (``jnp.float32`` & friends, ``astype(float)``,
+    ``dtype=float``) anywhere in a field plane;
+  * the literal ``4095`` (``0xFFF``) — use ``LIMB_MASK``;
+  * shifts by the literal ``12`` — use ``LIMB_BITS``;
+  * ``jax.ShapeDtypeStruct`` outputs in ``*_T.py`` kernels whose dtype
+    is not ``jnp.int32`` — transposed-kernel entry points must declare
+    int32 limb arrays.
+
+The defining assignments in ``ops/bls_jax.py`` are exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, SourceFile, dotted_name
+
+RULE = "limb-layout"
+
+_LIMB_CONSTS = ("N_LIMBS", "LIMB_BITS", "LIMB_MASK")
+_FLOAT_ATTRS = frozenset({"float32", "float64", "float16", "bfloat16"})
+_MASK_VALUE = 4095
+_BITS_VALUE = 12
+
+
+def applies(relpath: str) -> bool:
+    return relpath.startswith("ops/") and relpath != "ops/__init__.py"
+
+
+def _is_field_plane(sf: SourceFile) -> bool:
+    return any(c in sf.text for c in _LIMB_CONSTS)
+
+
+def _const_def_lines(sf: SourceFile) -> set:
+    """Module-level lines defining the limb constants (exempt)."""
+    lines = set()
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id in _LIMB_CONSTS
+            for t in node.targets
+        ):
+            lines.add(node.lineno)
+    return lines
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    field_plane = _is_field_plane(sf)
+    exempt = _const_def_lines(sf) if field_plane else set()
+    for node in ast.walk(sf.tree):
+        if field_plane and isinstance(node, ast.Attribute):
+            if node.attr in _FLOAT_ATTRS and dotted_name(node.value) in (
+                "jnp", "np", "jax.numpy", "numpy"
+            ):
+                out.append(
+                    sf.finding(
+                        RULE,
+                        node,
+                        f"float dtype .{node.attr} in a field plane — limb "
+                        "arithmetic is int32 end to end",
+                    )
+                )
+        elif field_plane and isinstance(node, ast.Constant):
+            if node.value == _MASK_VALUE and node.lineno not in exempt:
+                out.append(
+                    sf.finding(
+                        RULE,
+                        node,
+                        "literal 4095 — use LIMB_MASK so the limb width "
+                        "has one source of truth",
+                    )
+                )
+        elif field_plane and isinstance(node, ast.BinOp):
+            if isinstance(node.op, (ast.LShift, ast.RShift)) and (
+                isinstance(node.right, ast.Constant)
+                and node.right.value == _BITS_VALUE
+                and node.lineno not in exempt
+            ):
+                out.append(
+                    sf.finding(
+                        RULE,
+                        node,
+                        "shift by literal 12 — use LIMB_BITS so the limb "
+                        "width has one source of truth",
+                    )
+                )
+        elif field_plane and isinstance(node, ast.Call):
+            dn = dotted_name(node.func) or ""
+            if dn.rsplit(".", 1)[-1] == "astype":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id == "float":
+                        out.append(
+                            sf.finding(
+                                RULE,
+                                node,
+                                "astype(float) in a field plane — limb "
+                                "arithmetic is int32 end to end",
+                            )
+                        )
+            for kw in node.keywords:
+                if (
+                    kw.arg == "dtype"
+                    and isinstance(kw.value, ast.Name)
+                    and kw.value.id == "float"
+                ):
+                    out.append(
+                        sf.finding(
+                            RULE,
+                            node,
+                            "dtype=float in a field plane — limb "
+                            "arithmetic is int32 end to end",
+                        )
+                    )
+            if sf.relpath.endswith("_T.py") and dn.rsplit(".", 1)[-1] == (
+                "ShapeDtypeStruct"
+            ):
+                dtype_arg = None
+                if len(node.args) >= 2:
+                    dtype_arg = node.args[1]
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "dtype":
+                            dtype_arg = kw.value
+                if dtype_arg is not None:
+                    ddn = dotted_name(dtype_arg) or ""
+                    if ddn.rsplit(".", 1)[-1] != "int32":
+                        out.append(
+                            sf.finding(
+                                RULE,
+                                node,
+                                "transposed-kernel output declared "
+                                f"{ddn or '<non-int32>'} — T-layout entry "
+                                "points must declare int32 limb arrays",
+                            )
+                        )
+    return out

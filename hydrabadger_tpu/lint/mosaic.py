@@ -1,0 +1,144 @@
+"""Rule ``mosaic``: transposed kernels must stay Mosaic-lowerable.
+
+Contract (ops/fq_T.py module docstring): "Mosaic constraints honored
+throughout: no strided tensor slices ..., no bool vectors (int32
+masks), no dynamic_slice (all row slices are static 2-D)."  The
+``*_T.py`` modules run the SAME traced bodies as Pallas kernels on TPU
+and as plain XLA on CPU, so the whole module must satisfy the stricter
+(Mosaic) constraint set — a violation compiles fine on the CPU twin and
+explodes only on hardware.
+
+Flags, in ``ops/*_T.py``:
+
+  * slices with a step (``x[::2]`` — strided vector loads do not lower);
+  * ``lax.dynamic_slice`` / ``dynamic_update_slice`` (and the
+    ``_in_dim`` variants);
+  * explicit bool dtypes (``jnp.bool_`` / ``astype(bool)`` /
+    ``dtype=bool`` — masks must be int32; transient comparison results
+    consumed by ``where``/``astype`` are fine and are not flagged);
+  * non-static slice bounds (a bound containing a call, subscript or
+    attribute is not a trace-time Python int — Mosaic requires static
+    2-D row slices).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, SourceFile, dotted_name
+
+RULE = "mosaic"
+
+_DYNAMIC = frozenset(
+    {
+        "dynamic_slice",
+        "dynamic_update_slice",
+        "dynamic_slice_in_dim",
+        "dynamic_update_slice_in_dim",
+    }
+)
+
+# Attribute is allowed: `self.p_i`-style bounds are host-object Python
+# ints resolved at trace time (a traced bound would raise at trace
+# anyway); calls and subscripts inside a bound are what hide dynamism.
+_STATIC_BOUND_NODES = (
+    ast.Constant,
+    ast.Name,
+    ast.Attribute,
+    ast.BinOp,
+    ast.UnaryOp,
+    ast.operator,
+    ast.unaryop,
+    ast.expr_context,
+)
+
+
+def applies(relpath: str) -> bool:
+    return relpath.startswith("ops/") and relpath.endswith("_T.py")
+
+
+def _is_static_bound(node: ast.AST) -> bool:
+    return all(
+        isinstance(sub, _STATIC_BOUND_NODES) for sub in ast.walk(node)
+    )
+
+
+def _flag_bool_dtype(sf, node, out) -> None:
+    dn = dotted_name(node)
+    if dn in ("jnp.bool_", "np.bool_", "jax.numpy.bool_", "numpy.bool_"):
+        out.append(
+            sf.finding(
+                RULE,
+                node,
+                f"bool dtype {dn} — Mosaic has no bool vectors; use an "
+                "int32 mask",
+            )
+        )
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Slice):
+            if node.step is not None and not (
+                isinstance(node.step, ast.Constant) and node.step.value == 1
+            ):
+                out.append(
+                    sf.finding(
+                        RULE,
+                        node,
+                        "strided slice — Mosaic cannot lower strided "
+                        "tensor loads; restructure as split planes or a "
+                        "matmul recombination",
+                    )
+                )
+            for bound in (node.lower, node.upper):
+                if bound is not None and not _is_static_bound(bound):
+                    out.append(
+                        sf.finding(
+                            RULE,
+                            bound,
+                            "non-static slice bound — Mosaic row slices "
+                            "must be trace-time Python ints",
+                        )
+                    )
+        elif isinstance(node, ast.Attribute):
+            _flag_bool_dtype(sf, node, out)
+        elif isinstance(node, ast.Call):
+            dn = dotted_name(node.func) or ""
+            leaf = dn.rsplit(".", 1)[-1]
+            if leaf in _DYNAMIC:
+                out.append(
+                    sf.finding(
+                        RULE,
+                        node,
+                        f"{leaf} — Mosaic kernels must use static slices "
+                        "(select via one-hot MACs instead)",
+                    )
+                )
+            elif leaf == "astype":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id == "bool":
+                        out.append(
+                            sf.finding(
+                                RULE,
+                                node,
+                                "astype(bool) — Mosaic has no bool "
+                                "vectors; use an int32 mask",
+                            )
+                        )
+            for kw in getattr(node, "keywords", []):
+                if (
+                    kw.arg == "dtype"
+                    and isinstance(kw.value, ast.Name)
+                    and kw.value.id == "bool"
+                ):
+                    out.append(
+                        sf.finding(
+                            RULE,
+                            node,
+                            "dtype=bool — Mosaic has no bool vectors; use "
+                            "an int32 mask",
+                        )
+                    )
+    return out

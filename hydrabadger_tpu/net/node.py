@@ -28,8 +28,8 @@ import logging
 import random
 import time as _time
 from collections import deque
-from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
 
 from ..consensus.dynamic_honey_badger import DhbBatch, DynamicHoneyBadger, JoinPlan
 from ..consensus.types import NetworkInfo, Step
@@ -63,11 +63,34 @@ WIRE_RETRY_TICK_S = 0.25
 # re-broadcasts its current-epoch consensus frames (bounded ring)
 EPOCH_OUTBOX_MAX = 8192
 EPOCH_REPLAY_TICK_S = 1.0
+# connection keepalive (reference ping/pong, lib.rs WireMessageKind):
+# a quiet link and a dead link are indistinguishable to TCP for
+# minutes; a periodic ping keeps NAT/conntrack state warm and turns a
+# dead socket into a prompt reader-task error
+KEEPALIVE_TICK_S = 20.0
+# wire `transaction` frames are unsigned and reachable pre-handshake,
+# so the relay path bounds them; larger payloads belong in a signed
+# validator contribution
+MAX_TXN_BYTES = 1024 * 1024
 
 
 @dataclass
 class Config:
-    """Reference defaults: hydrabadger.rs:35-45."""
+    """Node configuration (reference defaults: hydrabadger.rs:35-45).
+
+    ``engine`` is the resolved contract of the reference's "convert to
+    builder pattern" TODO (hydrabadger.rs:49): backend selection hangs
+    off this Config and nowhere else.  The name ("cpu" | "tpu" | any
+    ``register_engine`` entry) is resolved through
+    ``crypto.engine.get_engine`` exactly once per consumer — at node
+    construction for the wire-signature plane (``Hydrabadger.engine``)
+    and at consensus-core construction for the batch crypto plane
+    (threaded into ``DynamicHoneyBadger``, including the
+    ``from_checkpoint`` / ``from_join_plan`` resume paths) — so one
+    Config swaps every crypto backend coherently and an unknown name
+    fails fast with ``ValueError`` instead of falling back silently.
+    Pinned by tests/test_net.py::test_config_engine_selects_backend.
+    """
 
     txn_gen_count: int = 5
     txn_gen_interval_ms: int = 5000
@@ -81,9 +104,7 @@ class Config:
     coin_mode: str = "threshold"
     verify_shares: bool = True
     wire_sign: bool = True  # BLS-sign/verify every frame (lib.rs:429-447)
-    # CryptoEngine backend ("cpu" | "tpu") — BASELINE.json's north star
-    # hangs engine selection off this Config (hydrabadger.rs:49's builder
-    # TODO made load-bearing)
+    # CryptoEngine backend name — see the class docstring
     engine: str = "cpu"
 
 
@@ -256,6 +277,40 @@ class Hydrabadger:
         self._internal.put_nowait(("api_vote", tuple(change)))
         return True
 
+    def submit_transaction(self, txn: bytes) -> bool:
+        """Inject a raw transaction (reference Transaction relay).
+
+        A validator folds it straight into its own pending
+        contributions.  An observer relays it to ONE reachable
+        validator (the first in the era's sorted validator set) — relaying
+        to all of them would commit the same txn under every proposer,
+        and nothing downstream dedups across contributions.  Before the
+        validator set is known (still bootstrapping) the relay is a
+        best-effort broadcast.  Returns False when the txn is oversized
+        (MAX_TXN_BYTES — receivers drop larger unsigned frames) or no
+        plausible recipient is reachable; True means handed off, not
+        committed — exactly-once semantics remain an application
+        concern (duplicate submissions to different validators commit
+        twice)."""
+        txn = bytes(txn)
+        if len(txn) > MAX_TXN_BYTES:
+            return False
+        if self.is_validator():
+            self._internal.put_nowait(("api_propose", txn))
+            return True
+        msg = wire.transaction(txn)
+        if self.dhb is not None:
+            for nid in self.dhb.netinfo.node_ids:
+                if nid == self.uid.bytes:
+                    continue
+                if self.peers.wire_to(Uid(bytes(nid)), msg):
+                    return True
+            return False  # only non-validators reachable: would be lost
+        if self.peers.count_established() == 0:
+            return False
+        self.peers.wire_to_all(msg)  # validator set unknown: best effort
+        return True
+
     def checkpoint(self):
         """Snapshot durable consensus identity (SURVEY.md §5.4).
 
@@ -321,6 +376,7 @@ class Hydrabadger:
         self._tasks.append(asyncio.create_task(self._keygen_retry_loop()))
         self._tasks.append(asyncio.create_task(self._wire_retry_loop()))
         self._tasks.append(asyncio.create_task(self._epoch_replay_loop()))
+        self._tasks.append(asyncio.create_task(self._keepalive_loop()))
         if gen_txns is not None:
             self._tasks.append(asyncio.create_task(self._generator_loop()))
         for remote in remotes or []:
@@ -657,12 +713,23 @@ class Hydrabadger:
         elif kind == "net_state":
             self._on_net_state(msg.payload)
         elif kind == "transaction":
-            if self.is_validator():
+            # unsigned kind, reachable before the handshake: accept only
+            # bounded raw bytes from an established peer.  (bytes() on an
+            # attacker-chosen codec value is the trap — bytes(10**12) is
+            # a terabyte zero-buffer allocation.)
+            if (
+                peer.state == "established"
+                and isinstance(msg.payload, (bytes, bytearray, memoryview))
+                and len(msg.payload) <= MAX_TXN_BYTES
+                and self.is_validator()
+            ):
                 self._internal.put_nowait(("api_propose", bytes(msg.payload)))
         elif kind == "goodbye":
             peer.close()
         elif kind == "ping":
-            peer.send(WireMessage("pong", None))
+            peer.send(wire.pong())
+        elif kind == "pong":
+            pass  # keepalive reply; receipt itself is the signal
 
     def _on_net_state(self, net_state) -> None:
         tag = net_state[0]
@@ -1259,6 +1326,19 @@ class Hydrabadger:
                     self.peers.wire_to_all(msg)
                 elif not self.peers.wire_to(target, msg):
                     self._queue_wire_retry(target, msg)
+
+    async def _keepalive_loop(self) -> None:
+        """Periodic ping to every established peer (wire `ping`/`pong`).
+
+        HBBFT itself is message-driven, so a fully-idle network sends
+        nothing — and a silently-dead TCP link then goes unnoticed until
+        the next consensus frame times out into the retry path.  The
+        ping forces traffic through each socket so the pump/reader tasks
+        observe breakage promptly; the pong reply needs no handling
+        beyond its dispatch arm."""
+        while True:
+            await asyncio.sleep(KEEPALIVE_TICK_S)
+            self.peers.wire_to_all(wire.ping())
 
     async def _keygen_retry_loop(self) -> None:
         """Bootstrap liveness: gossip + re-broadcast until DKG completes.

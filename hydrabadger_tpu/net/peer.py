@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 import logging
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional
 
 from ..crypto.threshold import PublicKey
 from ..utils.ids import InAddr, OutAddr, Uid
@@ -120,11 +120,30 @@ class Peers:
             peer.send(msg)
 
     def wire_to_validators(self, msg: WireMessage, validator_uids) -> None:
-        """Targeted multicast.  (The reference's equivalent falls back to
-        broadcasting to everyone — peer.rs:567-575 FIXME; we honor the
-        target set when every uid resolves, and fall back to a full
-        broadcast when any does not, so unresolved validators never
-        silently miss traffic.)"""
+        """Targeted multicast with an all-or-broadcast exclusion rule.
+
+        The reference never implemented the exclusion: its
+        ``wire_to_validators`` broadcasts to every peer with a FIXME
+        ("Exclude non-validators", peer.rs:567-575), because HBBFT
+        tolerates over-delivery (every handler drops frames from/for
+        ids outside its validator set) but NOT under-delivery (a
+        validator that misses a targeted RBC shard stalls the epoch).
+        This port resolves the FIXME in the only direction that is
+        safe under that asymmetry:
+
+        * every uid in ``validator_uids`` resolves to an established
+          connection -> send to exactly those peers (the exclusion the
+          reference wanted);
+        * ANY uid is unknown or still handshaking -> fall back to the
+          reference's full broadcast, so the unresolved validator can
+          still receive the frame via a connection registered after
+          this check (e.g. both directions of a duplicate-connection
+          tie-break).
+
+        Over-delivery costs bandwidth; under-delivery costs liveness.
+        Pinned by tests/test_net.py::test_wire_to_validators_exclusion
+        (targeted case) and ..._broadcast_fallback (unresolved case).
+        """
         targets = [self.get_by_uid(uid) for uid in validator_uids]
         if any(p is None or p.state != "established" for p in targets):
             self.wire_to_all(msg)

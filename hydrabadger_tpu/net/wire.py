@@ -173,3 +173,19 @@ def key_gen_message(src: Uid, instance_id: tuple, payload: tuple) -> WireMessage
 
 def goodbye(uid: Uid) -> WireMessage:
     return WireMessage("goodbye", (uid.bytes,))
+
+
+def transaction(payload: bytes) -> WireMessage:
+    """User txn relay (reference WireMessageKind::Transaction): an
+    observer or client-facing node forwards a raw transaction to the
+    validators, who fold it into their next contribution."""
+    return WireMessage("transaction", bytes(payload))
+
+
+def ping() -> WireMessage:
+    """Liveness probe; the peer answers with pong()."""
+    return WireMessage("ping", None)
+
+
+def pong() -> WireMessage:
+    return WireMessage("pong", None)

@@ -56,7 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto.bls12_381 import P
-from .bls_jax import N_LIMBS
+from .bls_jax import LIMB_BITS, N_LIMBS
 from .fp12_circuit import Circuit, _dominating_offset, _to_limbs_wide
 from .fq_T import (
     _carry_ks_rows,
@@ -219,7 +219,7 @@ class CircuitT:
             stacked = _carry_ks_rows(stacked)
             # Barrett quotient from the top limbs (rows 33/34 provably
             # zero), then one exact q*p subtract; never overshoots
-            u = stacked[31:32] + (stacked[32:33] << 12)
+            u = stacked[31:32] + (stacked[32:33] << LIMB_BITS)
             q = (u * _BARRETT_M) >> 18
             qp = _carry_ks_rows(norm_ref[:, self.p_i : self.p_i + 1] * q)
             stacked, _ = _sub_ks_rows(stacked, qp)

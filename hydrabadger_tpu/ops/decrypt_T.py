@@ -39,7 +39,7 @@ X/Z^2, Y/Z^3 cross-products, exactly like sim/tensor._jac_eq.
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +51,6 @@ from ..crypto import bls12_381 as bls
 from .bls_jax import BETA_COL, GLV_LAMBDA, N_LIMBS
 from . import fq_T
 from .fq_T import (
-    PL_COL,
     fq_mul_T,
     from_points_BC,
     jac_add_T,

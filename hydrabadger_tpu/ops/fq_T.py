@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .bls_jax import (
+    LIMB_BITS,
     LIMB_MASK,
     N_LIMBS,
     P_LIMBS,
@@ -88,7 +89,7 @@ def _carry_scan_rows(x):
 
     def step(c, row):
         t = row + c
-        return t >> 12, t & LIMB_MASK
+        return t >> LIMB_BITS, t & LIMB_MASK
 
     carry, limbs = lax.scan(step, jnp.zeros_like(x[0]), x)
     return limbs
@@ -103,7 +104,7 @@ def _sub_scan_rows(a, b):
         ai, bi = ab
         t = ai - bi - brw
         neg = (t < 0).astype(jnp.int32)
-        return neg, t + (neg << 12)
+        return neg, t + (neg << LIMB_BITS)
 
     borrow, limbs = lax.scan(
         step, jnp.zeros_like(a[0]), (a, bb)
@@ -120,9 +121,9 @@ def _carry_ks_rows(x):
     w = x.shape[0]
     for _ in range(3):
         lo = x & LIMB_MASK
-        hi = x >> 12
+        hi = x >> LIMB_BITS
         x = lo + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
-    g = (x >> 12 != 0).astype(jnp.int32)
+    g = (x >> LIMB_BITS != 0).astype(jnp.int32)
     p = ((x & LIMB_MASK) == LIMB_MASK).astype(jnp.int32)
     d = 1
     while d < w:

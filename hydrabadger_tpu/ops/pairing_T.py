@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .bls_jax import N_LIMBS, P_LIMBS
+from .bls_jax import N_LIMBS
 from .circuit_T import executor
 from .fq_T import PL_COL, _sub_rows, _use_pallas
 from .pairing_jax import (

@@ -36,7 +36,7 @@ inversion), so cached values are point-identical to the native fold.
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import List, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -84,7 +84,10 @@ def _fold_fn(J: int, K: int, M: int, nbits: int, xs_key: tuple):
             acc = jac_add_T(t, (Cj[0], Cj[1], Cj[2]))
             return acc, None
 
-        acc, _ = jax.lax.scan(step, acc0, rows[J - 2 :: -1])
+        # Horner descent over rows J-2..0: scan's reverse flag walks the
+        # leading rows back to front without a strided (negative-step)
+        # slice, which Mosaic cannot lower
+        acc, _ = jax.lax.scan(step, acc0, rows[: J - 1], reverse=True)
         return jnp.stack(acc)  # [3, 32, M*K]
 
     return fold

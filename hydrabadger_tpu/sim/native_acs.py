@@ -15,7 +15,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False

@@ -13,7 +13,7 @@ import random
 from collections import deque
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple, TypeVar
 
-from ..consensus.types import Step, TargetedMessage
+from ..consensus.types import Step
 
 N = TypeVar("N", bound=Hashable)
 

@@ -65,6 +65,42 @@ def test_uid_ordering_and_hash():
     assert len({a, b, Uid(a.bytes)}) == 2
 
 
+# -- wire-variant exhaustiveness (shared with hblint) ------------------------
+#
+# The sample set is built by lint/wire_contract.sample_messages, which
+# re-extracts wire.KINDS and raises on drift — a new wire kind cannot
+# ship without both a static dispatch arm (the wire-exhaustive lint
+# rule) and this runtime round-trip pin.
+
+
+def test_every_wire_variant_roundtrips():
+    from hydrabadger_tpu.lint import wire_contract
+    from hydrabadger_tpu.net import wire
+
+    msgs = wire_contract.sample_messages()
+    assert {m.kind for m in msgs} == set(wire.KINDS)
+    for msg in msgs:
+        decoded = wire.WireMessage.decode(msg.encode())
+        assert decoded == msg, msg.kind
+
+
+def test_wire_variant_encoding_is_canonical():
+    from hydrabadger_tpu.lint import wire_contract
+    from hydrabadger_tpu.net import wire
+
+    for msg in wire_contract.sample_messages():
+        raw = msg.encode()
+        assert wire.WireMessage.decode(raw).encode() == raw, msg.kind
+
+
+def test_unknown_wire_kind_rejected():
+    from hydrabadger_tpu.net.wire import WireMessage
+
+    raw = codec.encode(("no_such_kind", None))
+    with pytest.raises(ValueError):
+        WireMessage.decode(raw)
+
+
 # -- native twin (native/hb_codec.c) ----------------------------------------
 
 

@@ -1,0 +1,283 @@
+"""hblint self-tests: every rule fires on a known-bad snippet, the
+suppression pragma demands a justification, and the repo itself is
+clean (the tier-1 gate that keeps the contracts machine-checked)."""
+import textwrap
+from pathlib import Path
+
+from hydrabadger_tpu import lint
+from hydrabadger_tpu.lint import (
+    SourceFile,
+    deadcode,
+    jit_hygiene,
+    limb_layout,
+    mosaic,
+    sansio,
+    wire_contract,
+)
+
+
+def make_sf(tmp_path, relpath, code):
+    text = textwrap.dedent(code)
+    path = tmp_path / Path(relpath).name
+    path.write_text(text)
+    return SourceFile(path, relpath, text)
+
+
+# -- the repo-wide gate ------------------------------------------------------
+
+
+def test_package_has_zero_findings():
+    findings, _suppressed = lint.run()
+    assert not findings, "hblint findings:\n" + "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_cli_exits_zero_on_clean_repo():
+    from hydrabadger_tpu.lint.__main__ import main
+
+    assert main(["-q"]) == 0
+
+
+# -- rule self-tests: each must still fire on a known-bad snippet ------------
+
+
+def test_sansio_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "consensus/bad.py",
+        """\
+        import time
+        from random import random
+        import numpy as np
+
+        def tick(self):
+            object.__setattr__(self.msg, "round", 1)
+            return np.random.rand(), open("/tmp/x")
+        """,
+    )
+    messages = [f.message for f in sansio.check(sf)]
+    assert any("'time'" in m for m in messages)
+    assert any("'random'" in m for m in messages)
+    assert any("__setattr__" in m for m in messages)
+    assert any("NumPy RNG" in m for m in messages)
+    assert any("open()" in m for m in messages)
+    assert sansio.applies("consensus/broadcast.py")
+    assert not sansio.applies("net/node.py")  # the io plane MAY do io
+
+
+def test_mosaic_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "ops/bad_T.py",
+        """\
+        import jax.numpy as jnp
+        from jax import lax
+
+        def kernel(x, i, idx):
+            a = x[::2]
+            b = lax.dynamic_slice(x, (i,), (4,))
+            c = jnp.zeros((4,), jnp.bool_)
+            d = x[idx[0] : 4]
+            return a, b, c, d
+        """,
+    )
+    messages = [f.message for f in mosaic.check(sf)]
+    assert any("strided slice" in m for m in messages)
+    assert any("dynamic_slice" in m for m in messages)
+    assert any("bool" in m for m in messages)
+    assert any("non-static slice bound" in m for m in messages)
+    assert mosaic.applies("ops/fq_T.py")
+    assert not mosaic.applies("ops/bls_jax.py")  # composed-XLA plane
+
+
+def test_mosaic_allows_static_and_attribute_bounds(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "ops/ok_T.py",
+        """\
+        def body(x, i, self):
+            a = x[i : i + 1]
+            b = x[: 4]
+            c = x[self.p_i : self.p_i + 1]
+            return a, b, c
+        """,
+    )
+    assert mosaic.check(sf) == []
+
+
+def test_jit_hygiene_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "ops/bad.py",
+        """\
+        from functools import partial
+        import jax
+        import numpy as np
+        import jax.experimental.pallas as pl
+
+        @jax.jit
+        def f(x):
+            return float(x)
+
+        @partial(jax.jit, static_argnames=())
+        def g(x):
+            return np.asarray(x).item()
+
+        def kernel(ref, o_ref):
+            o_ref[:] = ref[:].tolist()
+
+        def launch(x):
+            return pl.pallas_call(kernel, out_shape=None)(x)
+
+        def host_side_is_fine(x):
+            return int(x) + float(x)
+        """,
+    )
+    findings = jit_hygiene.check(sf)
+    messages = [f.message for f in findings]
+    assert any("float() inside traced region 'f'" in m for m in messages)
+    assert any("np.asarray inside traced region 'g'" in m for m in messages)
+    assert any(".item() inside traced region 'g'" in m for m in messages)
+    assert any(
+        ".tolist() inside traced region 'kernel'" in m for m in messages
+    )
+    # host-side coercions outside traced regions are NOT flagged
+    assert not any("host_side_is_fine" in m for m in messages)
+    assert jit_hygiene.applies("crypto/engine.py")
+    assert not jit_hygiene.applies("net/node.py")
+
+
+def test_limb_layout_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "ops/bad_T.py",
+        """\
+        import jax
+        import jax.numpy as jnp
+        from .bls_jax import N_LIMBS
+
+        def f(x):
+            y = x & 4095
+            z = x >> 12
+            w = jnp.zeros((4,), jnp.float32)
+            s = jax.ShapeDtypeStruct((N_LIMBS, 8), jnp.float32)
+            return y, z, w, s
+        """,
+    )
+    messages = [f.message for f in limb_layout.check(sf)]
+    assert any("LIMB_MASK" in m for m in messages)
+    assert any("LIMB_BITS" in m for m in messages)
+    assert any("float dtype .float32" in m for m in messages)
+    assert any("int32 limb arrays" in m for m in messages)
+
+
+def test_limb_layout_exempts_defining_assignments(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "ops/consts.py",
+        """\
+        LIMB_BITS = 12
+        N_LIMBS = 32
+        LIMB_MASK = 4095
+        """,
+    )
+    assert limb_layout.check(sf) == []
+
+
+def test_wire_exhaustive_fires_on_known_bad(tmp_path):
+    net = tmp_path / "net"
+    net.mkdir()
+    (net / "wire.py").write_text(
+        textwrap.dedent(
+            """\
+            KINDS = frozenset({"hello", "data", "bye"})
+            VERIFIED_KINDS = frozenset({"ghost"})
+            """
+        )
+    )
+    (net / "node.py").write_text(
+        textwrap.dedent(
+            """\
+            def handle(msg, peer):
+                kind = msg.kind
+                if kind == "hello":
+                    peer.send(WireMessage("hello", None))
+                elif kind == "data":
+                    peer.send(WireMessage("undeclared", None))
+
+            def internal_dispatch(item, peer):
+                kind = item[0]
+                if kind == "bye":
+                    pass  # internal queue tag, NOT a wire dispatch arm
+            """
+        )
+    )
+    sf = SourceFile(
+        net / "wire.py", "net/wire.py", (net / "wire.py").read_text()
+    )
+    messages = [f.message for f in wire_contract.check(sf)]
+    assert any("'undeclared'" in m and "not declared" in m for m in messages)
+    assert any("'bye'" in m and "never constructed" in m for m in messages)
+    assert any("'bye'" in m and "no dispatch arm" in m for m in messages)
+    assert any("'ghost'" in m for m in messages)
+    # 'hello' is declared + constructed + dispatched: silent
+    assert not any("'hello'" in m for m in messages)
+
+
+def test_deadcode_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "utils/bad.py",
+        """\
+        import sys
+        import hashlib
+
+        def main():
+            return sys.argv
+        """,
+    )
+    messages = [f.message for f in deadcode.check(sf)]
+    assert any("'hashlib'" in m for m in messages)
+    assert not any("'sys'" in m for m in messages)
+    assert not deadcode.applies("utils/__init__.py")  # re-export surface
+
+
+# -- suppression mechanics ---------------------------------------------------
+
+
+def test_suppression_with_justification_silences(tmp_path):
+    cons = tmp_path / "consensus"
+    cons.mkdir()
+    (cons / "bad.py").write_text(
+        "import time  # hblint: disable=sans-io -- fixture uses a frozen clock\n"
+        "time.time()\n"
+    )
+    findings, suppressed = lint.run(root=tmp_path, rules=[sansio])
+    assert suppressed == 1
+    assert not [f for f in findings if f.rule == "sans-io"]
+
+
+def test_suppression_comment_above_statement(tmp_path):
+    cons = tmp_path / "consensus"
+    cons.mkdir()
+    (cons / "bad.py").write_text(
+        "# hblint: disable=sans-io -- fixture uses a frozen clock\n"
+        "import time\n"
+        "time.time()\n"
+    )
+    findings, suppressed = lint.run(root=tmp_path, rules=[sansio])
+    assert suppressed == 1
+    assert not [f for f in findings if f.rule == "sans-io"]
+
+
+def test_suppression_without_justification_is_a_finding(tmp_path):
+    cons = tmp_path / "consensus"
+    cons.mkdir()
+    (cons / "bad.py").write_text("import socket  # hblint: disable=sans-io\n")
+    findings, suppressed = lint.run(root=tmp_path, rules=[sansio])
+    assert suppressed == 0
+    rules = {f.rule for f in findings}
+    # the naked pragma is itself flagged AND does not suppress
+    assert "suppression" in rules
+    assert "sans-io" in rules

@@ -264,6 +264,130 @@ async def test_restart_world_from_checkpoints_over_tcp():
         await stop_cluster(restored)
 
 
+def test_config_engine_selects_backend():
+    """Config.engine is the single backend switch (the reference's
+    hydrabadger.rs:49 builder TODO, resolved — see the Config
+    docstring): the node resolves it through get_engine once, and an
+    unknown name fails fast instead of silently falling back."""
+    from hydrabadger_tpu.crypto.engine import CpuEngine, get_engine
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 70), fast_config(engine="cpu"), seed=7
+    )
+    assert node.engine is get_engine("cpu")
+    assert isinstance(node.engine, CpuEngine)
+    with pytest.raises(ValueError):
+        Hydrabadger(
+            InAddr("127.0.0.1", BASE_PORT + 71),
+            fast_config(engine="no-such-backend"),
+            seed=7,
+        )
+
+
+class _QueueOnlyWire:
+    """Stands in for a WireStream; Peer.send only touches send_queue."""
+
+    peer_pk = None
+
+
+def _established_peer(port, uid=None):
+    from hydrabadger_tpu.net.peer import Peer
+
+    peer = Peer(OutAddr("127.0.0.1", port), _QueueOnlyWire())
+    peer.uid = uid or Uid()
+    peer.state = "established"
+    return peer
+
+
+def _drain(peer):
+    out = []
+    while not peer.send_queue.empty():
+        out.append(peer.send_queue.get_nowait())
+    return out
+
+
+@pytest.mark.asyncio
+async def test_wire_to_validators_exclusion():
+    """All targets resolved -> ONLY the target set receives (the
+    exclusion the reference left as a FIXME, peer.rs:567-575; see the
+    wire_to_validators docstring)."""
+    from hydrabadger_tpu.net.peer import Peers
+
+    peers = Peers()
+    validator = _established_peer(1)
+    observer = _established_peer(2)
+    for p in (validator, observer):
+        peers.add(p)
+        peers.establish(p)
+    msg = WireMessage("ping", None)
+    peers.wire_to_validators(msg, [validator.uid])
+    assert _drain(validator) == [msg]
+    assert _drain(observer) == []  # excluded: not in the target set
+
+
+@pytest.mark.asyncio
+async def test_wire_to_validators_broadcast_fallback():
+    """ANY unresolved target -> full broadcast (over-delivery is safe,
+    under-delivery stalls an epoch — the docstring's asymmetry)."""
+    from hydrabadger_tpu.net.peer import Peers
+
+    peers = Peers()
+    validator = _established_peer(1)
+    observer = _established_peer(2)
+    handshaking = _established_peer(3)
+    handshaking.state = "handshaking"
+    for p in (validator, observer, handshaking):
+        peers.add(p)
+    for p in (validator, observer):
+        peers.establish(p)
+    msg = WireMessage("ping", None)
+    # one target is a uid with no established connection at all
+    peers.wire_to_validators(msg, [validator.uid, Uid()])
+    assert _drain(validator) == [msg]
+    assert _drain(observer) == [msg]  # fallback reaches everyone est.
+    assert _drain(handshaking) == []  # never pre-handshake
+
+    # a target that is known but still handshaking also forces fallback
+    peers.by_uid[handshaking.uid] = handshaking.out_addr
+    peers.wire_to_validators(msg, [validator.uid, handshaking.uid])
+    assert _drain(validator) == [msg]
+    assert _drain(observer) == [msg]
+
+
+@pytest.mark.asyncio
+async def test_transaction_arm_rejects_unbounded_and_prehandshake():
+    """The wire `transaction` kind is unsigned and reachable before the
+    handshake: the dispatch arm must take only bounded raw bytes from an
+    established peer (bytes(10**12) would be a 1 TB allocation)."""
+    from hydrabadger_tpu.net.node import MAX_TXN_BYTES
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 80), fast_config(), seed=9
+    )
+    node.is_validator = lambda: True
+    peer = _established_peer(1)
+
+    node._on_peer_msg(peer, WireMessage("transaction", 10**12), b"", b"")
+    node._on_peer_msg(peer, WireMessage("transaction", ("t", 1)), b"", b"")
+    node._on_peer_msg(
+        peer, WireMessage("transaction", b"\x00" * (MAX_TXN_BYTES + 1)),
+        b"", b"",
+    )
+    assert node._internal.empty()  # int / tuple / oversized all dropped
+
+    stranger = _established_peer(2)
+    stranger.state = "handshaking"
+    node._on_peer_msg(stranger, WireMessage("transaction", b"x"), b"", b"")
+    assert node._internal.empty()  # pre-handshake peers are not trusted
+
+    node._on_peer_msg(peer, WireMessage("transaction", b"good-txn"), b"", b"")
+    assert node._internal.get_nowait() == ("api_propose", b"good-txn")
+
+    # sender side honors the same bound
+    node.is_validator = lambda: False
+    assert not node.submit_transaction(b"\x00" * (MAX_TXN_BYTES + 1))
+
+
 @pytest.mark.asyncio
 async def test_wire_retry_queue_redelivers_targeted_frames():
     """A targeted consensus frame to a momentarily-unconnected peer is

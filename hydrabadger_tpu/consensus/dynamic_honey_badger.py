@@ -332,6 +332,26 @@ class DynamicHoneyBadger:
             self.rng,
             session=self._kg_session(kg_era),
         )
+        # The replay must reproduce the LIVE acceptance schedule, not
+        # the flat entry order: live nodes defer a batch's parts to one
+        # end-of-batch flush (round 6) while acks process inline, so a
+        # Byzantine same-batch (part, ack-for-it) pair is rejected live
+        # — replaying the flat transcript inline would ACCEPT that ack,
+        # diverge the completed-proposal set, fail the pk_set equality
+        # below, and strand the joiner forever.  "batch" boundary
+        # markers in the transcript (appended by _on_batch) carry the
+        # schedule; parts buffer until the marker.
+        deferred: List[Tuple] = []
+
+        def _flush_deferred() -> None:
+            if not deferred:
+                return
+            try:
+                kg.handle_parts(list(deferred))
+            except (ValueError, TypeError, KeyError, IndexError):
+                pass
+            deferred.clear()
+
         for proposer, msg in entries:
             # wire transport delivers ids as raw bytes; logic-tier
             # callers pass whatever id type the network uses
@@ -344,20 +364,25 @@ class DynamicHoneyBadger:
             try:
                 kind = msg[0]
                 if kind == "part":
-                    kg.handle_part(
-                        proposer,
-                        Part(
-                            _as_bytes(msg[1]),
-                            tuple(_as_bytes(r) for r in msg[2]),
-                        ),
+                    deferred.append(
+                        (
+                            proposer,
+                            Part(
+                                _as_bytes(msg[1]),
+                                tuple(_as_bytes(r) for r in msg[2]),
+                            ),
+                        )
                     )
                 elif kind == "ack":
                     kg.handle_ack(
                         proposer,
                         Ack(int(msg[1]), tuple(_as_bytes(v) for v in msg[2])),
                     )
+                elif kind == "batch":
+                    _flush_deferred()
             except (ValueError, TypeError, KeyError, IndexError):
                 continue
+        _flush_deferred()  # tail batch (defensive: markers close batches)
         try:
             pk_set, sk_share = kg.generate()
         except (ValueError, TypeError, KeyError, IndexError):
@@ -410,6 +435,9 @@ class DynamicHoneyBadger:
         step = Step()
         contributions = {}
         batch_votes: List[Tuple] = []  # (proposer, vote) in commit order
+        kg_parts: List[Tuple] = []  # (proposer, Part) deferred to one flush
+        kg_state = self.key_gen  # the keygen receiving this batch's msgs
+        kg_tlen = len(kg_state.transcript) if kg_state is not None else 0
         for proposer, payload in sorted(hb_batch.contributions.items()):
             try:
                 user, votes, kg_msgs = codec.decode(bytes(payload))
@@ -426,7 +454,14 @@ class DynamicHoneyBadger:
                     self.pending_kg = [
                         m for m in self.pending_kg if _freeze(m) != kg_t
                     ]
-                self._commit_keygen_msg(proposer, kg, step)
+                self._commit_keygen_msg(proposer, kg, step, kg_parts)
+        self._flush_keygen_parts(kg_parts, step)
+        if kg_state is not None and len(kg_state.transcript) > kg_tlen:
+            # batch-boundary marker: install_share_from_transcript
+            # replays parts on the live deferred-flush schedule, and the
+            # flat transcript cannot express where a batch ended without
+            # it (only batches that committed keygen traffic need one)
+            kg_state.transcript.append((b"", ("batch",)))
         self._commit_votes_batch(batch_votes, step)
         self.epoch = self.era + hb_batch.epoch + 1
         change = None
@@ -615,30 +650,46 @@ class DynamicHoneyBadger:
                 tuple(change), new_ids, new_pub_keys, _RemovedTracker(new_ids)
             )
 
-    def _commit_keygen_msg(self, proposer, kg, step: Step) -> None:
+    def _commit_keygen_msg(
+        self, proposer, kg, step: Step, parts_buf: Optional[List] = None
+    ) -> None:
         state = self.key_gen
         if state is None:
             return  # no active keygen: stale message
-        state.transcript.append((proposer, tuple(kg)))
         try:
-            kind = kg[0]
+            frozen = tuple(kg)
+            kind = frozen[0]
+        except (ValueError, TypeError, IndexError):
+            step.fault(proposer, "dhb: malformed keygen message")
+            return
+        if kind in ("part", "ack"):
+            # Only replayable protocol messages enter the committed
+            # transcript.  The "batch" boundary markers _on_batch
+            # appends are OUT-OF-BAND schedule data: recording an
+            # attacker-SENT ("batch",) here would let one Byzantine
+            # validator inject an early part-flush into every future
+            # replayer's schedule and desync it from the live gate.
+            state.transcript.append((proposer, frozen))
+        try:
             if kind == "part":
                 part = Part(
                     _as_bytes(kg[1]), tuple(_as_bytes(r) for r in kg[2])
                 )
-                outcome = state.key_gen.handle_part(proposer, part)
-                if outcome is None:
+                if parts_buf is not None and hasattr(
+                    state.key_gen, "handle_parts"
+                ):
+                    # Poll-level aggregation (round 6): defer the part
+                    # so the whole committed batch's row RLC checks
+                    # settle as ONE batched MSM in _flush_keygen_parts.
+                    # Order-safe for honest flows: an ack is only ever
+                    # produced AFTER its part commits, so it rides a
+                    # strictly later batch — no committed ack can
+                    # reference a same-batch part.  (A Byzantine sender
+                    # violating that ordering faults either way.)
+                    parts_buf.append((proposer, part))
                     return
-                if not outcome.valid:
-                    step.fault(proposer, f"dhb keygen: {outcome.fault}")
-                elif outcome.ack is not None and self.is_validator:
-                    self.pending_kg.append(
-                        (
-                            "ack",
-                            outcome.ack.proposer_idx,
-                            tuple(outcome.ack.enc_values),
-                        )
-                    )
+                outcome = state.key_gen.handle_part(proposer, part)
+                self._apply_part_outcome(proposer, outcome, step)
             elif kind == "ack":
                 ack = Ack(int(kg[1]), tuple(_as_bytes(v) for v in kg[2]))
                 outcome = state.key_gen.handle_ack(proposer, ack)
@@ -648,6 +699,50 @@ class DynamicHoneyBadger:
                 step.fault(proposer, "dhb: unknown keygen message")
         except (ValueError, TypeError, KeyError):
             step.fault(proposer, "dhb: malformed keygen message")
+
+    def _apply_part_outcome(self, proposer, outcome, step: Step) -> None:
+        if outcome is None:
+            return
+        if not outcome.valid:
+            step.fault(proposer, f"dhb keygen: {outcome.fault}")
+        elif outcome.ack is not None and self.is_validator:
+            self.pending_kg.append(
+                (
+                    "ack",
+                    outcome.ack.proposer_idx,
+                    tuple(outcome.ack.enc_values),
+                )
+            )
+
+    def _flush_keygen_parts(self, parts_buf: List, step: Step) -> None:
+        """Settle all parts deferred from one committed batch: every
+        row/commitment RLC check runs as one batched MSM and the ack
+        values seal through the batched channel plane
+        (SyncKeyGen.handle_parts) — n host Pippengers and n^2 per-value
+        seal calls collapse into one call each per batch."""
+        if not parts_buf:
+            return
+        state = self.key_gen
+        if state is None:
+            return
+        try:
+            outcomes = state.key_gen.handle_parts(parts_buf)
+        except (ValueError, TypeError, KeyError):
+            # Defensive only: handle_parts judges malformed input via
+            # outcomes (non-member senders included) and its batched
+            # crypto is internally guarded, so this should be
+            # unreachable.  Do NOT re-run per part: the batch records
+            # proposal state as it goes, so a re-run would take the
+            # duplicate path (ack=None) and silently withhold our acks.
+            # Fault loudly instead — if our crypto plane is throwing we
+            # cannot ack anyway, and a missed era switch degrades us to
+            # observer (_switch_era's generate guard) rather than
+            # forking anyone.
+            for proposer, _part in parts_buf:
+                step.fault(proposer, "dhb: keygen part batch failed")
+            return
+        for (proposer, _part), outcome in zip(parts_buf, outcomes):
+            self._apply_part_outcome(proposer, outcome, step)
 
     def _switch_era(self, step: Step) -> None:
         state = self.key_gen
@@ -739,6 +834,17 @@ class _RemovedTracker:
         self.commitments[idx] = commit
         self.ack_counts[idx] = set()
         return PartOutcome(True)
+
+    def handle_parts(self, items):
+        """Batch twin of handle_part (sequential — the tracker does no
+        crypto).  Load-bearing for gate agreement: _on_batch DEFERS
+        parts to one end-of-batch flush whenever the keygen object has
+        handle_parts, so the tracker must defer on the same schedule —
+        if it recorded parts inline while validators deferred, a
+        Byzantine same-batch (part, ack-for-it) pair would be counted
+        by the tracker but faulted by the validators, firing the
+        era-switch gate at different committed batches."""
+        return [self.handle_part(s, p) for s, p in items]
 
     def handle_ack(self, sender_id, ack: Ack):
         from ..crypto.dkg import AckOutcome

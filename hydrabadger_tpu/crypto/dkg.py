@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Generic, Hashable, List, Mapping, Optional, Tuple, TypeVar
 
 from ..utils import codec
+from ..utils.lru import DigestLRU
 from . import native_bls
 from .bls12_381 import FQ, G1, R, add, eq, g1_from_bytes, g1_to_bytes, infinity, mul_sub
 from .threshold import (
@@ -115,12 +116,70 @@ def rlc_scalars(seed: bytes, n: int) -> List[int]:
 def g1_msm_or_fallback(points, scalars):
     """Native Pippenger MSM when available, else the plain sum — the one
     shared implementation for every RLC right-hand side."""
+    if len(points) != len(scalars):
+        # loud on every route: the native path sizes its scalar buffer
+        # from len(points) and would read out of bounds, the pure path
+        # would silently zip-truncate
+        raise ValueError("points/scalars length mismatch")
     if native_bls.available():
         return native_bls.g1_msm(points, scalars)
     acc = infinity(FQ)
     for pt, s in zip(points, scalars):
         acc = add(acc, mul_sub(pt, s))
     return acc
+
+
+def _accel_mode() -> str:
+    """The ONE HYDRABADGER_TPU_DKG gate: "" (off), "forced" (env=1 —
+    bench/tests own the trade-off), or "auto" (jax ALREADY loaded with
+    a TPU backend).  Never imports jax unprompted — the TCP runtime
+    must not dial the accelerator tunnel as a side effect of handling a
+    key-gen message.  Callers layer their own size criterion on "auto"
+    (_tpu_dkg_mode: matrix degree; _tpu_msm_enabled: batch muls)."""
+    import os
+    import sys
+
+    env = os.environ.get("HYDRABADGER_TPU_DKG", "")
+    if env == "1":
+        return "forced"
+    if env == "0" or "jax" not in sys.modules:
+        return ""
+    try:
+        import jax
+
+        return "auto" if jax.default_backend() == "tpu" else ""
+    except Exception:  # pragma: no cover
+        return ""
+
+
+def _tpu_msm_enabled(n_muls: int) -> bool:
+    """Route a batch of MSM jobs to the device plane (ops/msm_T)?
+    Auto mode additionally wants enough independent point-muls in the
+    batch to amortize a dispatch."""
+    mode = _accel_mode()
+    return mode == "forced" or (mode == "auto" and n_muls >= 256)
+
+
+def g1_msm_batch(jobs):
+    """Evaluate MANY independent MSMs: jobs is a sequence of
+    (points, scalars) pairs, returns one combined point per job.
+
+    One device dispatch through the batched MSM plane (ops/msm_T) when
+    the TPU DKG plane is on and there is more than one job; otherwise
+    the native Pippenger / plain sum per job — the bit-exact fallback
+    (and the oracle ops/msm_T is pinned against).  This is the same
+    routing CryptoEngine.g1_msm_batch exposes to the protocol layers."""
+    jobs = list(jobs)
+    if len(jobs) > 1 and _tpu_msm_enabled(sum(len(p) for p, _s in jobs)):
+        try:
+            from ..ops import msm_T
+
+            return msm_T.g1_msm_batch(jobs)
+        except ValueError:
+            raise  # structural (length mismatch): loud on every route
+        except Exception:  # pragma: no cover - device failure
+            pass
+    return [g1_msm_or_fallback(p, s) for p, s in jobs]
 
 
 def _keystream_xor(key: bytes, ctx: bytes, data: bytes) -> bytes:
@@ -154,6 +213,48 @@ def _open(key: bytes, ctx: bytes, blob: bytes) -> Optional[bytes]:
     if not hmac_mod.compare_digest(want, tag):
         return None
     return _keystream_xor(key, ctx, ct)
+
+
+def _seal_batch(items) -> List[bytes]:
+    """Seal a batch of (key, ctx, msg) channel values in one pass —
+    bit-identical to _seal per item.  A 128-node era switch seals ~2M
+    values (n ack values per part, n parts, at every node); binding the
+    hash primitives once and inlining the single-block keystream (ack
+    values are 32 bytes) is worth ~2x Python overhead at that volume."""
+    sha = hashlib.sha256
+    hdigest = hmac_mod.digest
+    out = []
+    for key, ctx, msg in items:
+        n = len(msg)
+        if n <= 32:
+            ks = sha(key + b"|enc|" + ctx + b"\x00\x00\x00\x00").digest()[:n]
+        else:
+            prefix = key + b"|enc|" + ctx
+            parts = [
+                sha(prefix + ctr.to_bytes(4, "big")).digest()
+                for ctr in range((n + 31) // 32)
+            ]
+            ks = b"".join(parts)[:n]
+        ct = (
+            int.from_bytes(msg, "big") ^ int.from_bytes(ks, "big")
+        ).to_bytes(n, "big")
+        out.append(ct + hdigest(key, b"|mac|" + ctx + ct, "sha256")[:16])
+    return out
+
+
+# Process-wide channel-key cache: the static-DH key for a node pair is
+# SYMMETRIC (pk_b·sk_a == pk_a·sk_b), so in-process multi-node runtimes
+# (the sim, bench config 5) derive every pairwise key twice — n^2 host
+# ladders per era where n^2/2 suffice.  Keyed by the unordered pair of
+# public keys; values are derived channel keys (no secrets beyond what
+# each SyncKeyGen already holds — any process member of the pair could
+# compute it).
+_CHAN_KEY_CACHE: "DigestLRU[bytes]" = DigestLRU(16384)
+
+
+def _pair_digest(pk_a: bytes, pk_b: bytes) -> bytes:
+    lo, hi = (pk_a, pk_b) if pk_a <= pk_b else (pk_b, pk_a)
+    return hashlib.sha256(b"HBTPU-DKG-pair" + lo + hi).digest()
 
 
 # ---------------------------------------------------------------------------
@@ -200,28 +301,18 @@ class BivarPoly:
         )
 
 
-def _tpu_dkg_enabled(t: int) -> bool:
+def _tpu_dkg_mode(t: int) -> str:
     """Batch the per-node commitment folds on the accelerator?
 
-    Opt-in via HYDRABADGER_TPU_DKG=1 (bench/tests), or automatic when
-    jax is ALREADY loaded with a TPU backend and the matrix is big
-    enough to amortize a dispatch.  Never imports jax unprompted — the
-    TCP runtime must not dial the accelerator tunnel as a side effect
-    of handling a key-gen message."""
-    import os
-    import sys
-
-    env = os.environ.get("HYDRABADGER_TPU_DKG", "")
-    if env == "1":
-        return True
-    if env == "0" or "jax" not in sys.modules:
-        return False
-    try:
-        import jax
-
-        return jax.default_backend() == "tpu" and t >= 16
-    except Exception:  # pragma: no cover
-        return False
+    "forced" (bench/tests, where the in-process sim shares one decoded
+    commitment across all nodes so warming EVERY column pays) or "auto"
+    with a matrix big enough to amortize a dispatch — a real
+    distributed validator, which consumes only its own column
+    (ADVICE r5).  Gating itself lives in _accel_mode."""
+    mode = _accel_mode()
+    if mode == "auto" and t < 16:
+        return ""
+    return mode
 
 
 class BivarCommitment:
@@ -464,22 +555,77 @@ class SyncKeyGen(Generic[N]):
             raise ValueError("our_id must be among pub_keys")
         if len(self.node_ids) <= threshold:
             raise ValueError("need more than `threshold` nodes")
-        self.our_idx = self.node_ids.index(our_id)
+        self._index = {nid: i for i, nid in enumerate(self.node_ids)}
+        self.our_idx = self._index[our_id]
         self.parts: Dict[int, _ProposalState] = {}
         self._chan_keys: Dict[int, bytes] = {}
+        self._our_pk_bytes = self.pub_keys[our_id].to_bytes()
 
     # -- pairwise channels --------------------------------------------------
 
     def _chan_key(self, idx: int) -> bytes:
-        """Static-DH channel key with node `idx` (symmetric both ways)."""
+        """Static-DH channel key with node `idx` (symmetric both ways).
+
+        Consults the process-wide pair cache first: in-process
+        multi-node runtimes derive each pairwise key once instead of
+        once per side."""
         key = self._chan_keys.get(idx)
         if key is None:
-            dh = mul_sub(
-                self.pub_keys[self.node_ids[idx]].point, self.our_sk.scalar
-            )
-            key = hashlib.sha256(b"HBTPU-DKG-CH" + g1_to_bytes(dh)).digest()
+            peer_pk = self.pub_keys[self.node_ids[idx]]
+            pair = _pair_digest(self._our_pk_bytes, peer_pk.to_bytes())
+            key = _CHAN_KEY_CACHE.get(pair)
+            if key is None:
+                dh = mul_sub(peer_pk.point, self.our_sk.scalar)
+                key = hashlib.sha256(
+                    b"HBTPU-DKG-CH" + g1_to_bytes(dh)
+                ).digest()
+                _CHAN_KEY_CACHE.put(pair, key)
             self._chan_keys[idx] = key
         return key
+
+    def warm_channel_keys(self) -> None:
+        """Derive every missing pairwise channel key for this DKG
+        instance in ONE batched scalar-mul call — the era's outgoing
+        ack/row sealing then never pays a lazy host ladder per peer
+        mid-poll.  Pair-cache hits (the other side of an in-process
+        node already derived the key) are drained first; the true
+        misses batch through the device plane when the TPU DKG plane is
+        enabled, else the native GLV batch."""
+        todo = []
+        for m in range(len(self.node_ids)):
+            if m in self._chan_keys:
+                continue
+            peer_pk = self.pub_keys[self.node_ids[m]]
+            pair = _pair_digest(self._our_pk_bytes, peer_pk.to_bytes())
+            cached = _CHAN_KEY_CACHE.get(pair)
+            if cached is not None:
+                self._chan_keys[m] = cached
+                continue
+            todo.append((m, pair, peer_pk.point))
+        if not todo:
+            return
+        pts = [p for _m, _d, p in todo]
+        dhs = None
+        if len(pts) > 1 and _tpu_msm_enabled(4 * len(pts)):
+            try:
+                from ..ops import bls_jax
+
+                dhs = bls_jax.g1_scalar_mul_batch(
+                    pts, [self.our_sk.scalar] * len(pts)
+                )
+            except Exception:  # pragma: no cover - device failure
+                dhs = None
+        if dhs is None:
+            if native_bls.available():
+                dhs = native_bls.g1_mul_batch(
+                    pts, [self.our_sk.scalar] * len(pts)
+                )
+            else:
+                dhs = [mul_sub(p, self.our_sk.scalar) for p in pts]
+        for (m, pair, _p), dh in zip(todo, dhs):
+            key = hashlib.sha256(b"HBTPU-DKG-CH" + g1_to_bytes(dh)).digest()
+            _CHAN_KEY_CACHE.put(pair, key)
+            self._chan_keys[m] = key
 
     def _row_ctx(self, proposer: int, recipient: int) -> bytes:
         return (
@@ -505,25 +651,35 @@ class SyncKeyGen(Generic[N]):
     def propose(self) -> Part:
         poly = BivarPoly.random(self.threshold, self.rng)
         commit = poly.commitment()
-        enc_rows = []
-        for m, nid in enumerate(self.node_ids):
-            row = poly.row(m + 1)
-            enc_rows.append(
-                _seal(
+        self.warm_channel_keys()  # one batched derivation for the era
+        enc_rows = _seal_batch(
+            [
+                (
                     self._chan_key(m),
                     self._row_ctx(self.our_idx, m),
-                    codec.encode(row),
+                    codec.encode(poly.row(m + 1)),
                 )
-            )
+                for m in range(len(self.node_ids))
+            ]
+        )
         return Part(commit.to_bytes(), tuple(enc_rows))
 
     # -- handling -----------------------------------------------------------
 
     def node_index(self, node_id: N) -> int:
-        return self.node_ids.index(node_id)
+        idx = self._index.get(node_id)
+        if idx is None:
+            raise ValueError(f"unknown node id {node_id!r}")
+        return idx
 
     def handle_part(self, sender_id: N, part: Part) -> PartOutcome:
-        """Record a proposal.
+        """Record one proposal — see handle_parts for the check split."""
+        return self.handle_parts([(sender_id, part)])[0]
+
+    def handle_parts(
+        self, items: List[Tuple[N, Part]]
+    ) -> List[PartOutcome]:
+        """Record a POLL'S WORTH of proposals with batched crypto.
 
         Checks split into two classes with different consequences:
         STRUCTURAL checks (decodable commitment, degree, row count,
@@ -535,52 +691,108 @@ class SyncKeyGen(Generic[N]):
         nodes, so their failure must NOT change the recorded proposal
         set — the part is recorded (completion stays objective), the
         proposer is faulted, and we simply do not ack.  A victim still
-        derives its share from t+1 honest ackers' values."""
-        s = self.node_index(sender_id)
-        if s in self.parts:
-            existing = self.parts[s]
-            if existing.commitment.to_bytes() != part.commit_bytes:
-                return PartOutcome(False, fault="conflicting part")
-            return PartOutcome(True)  # duplicate; ack already sent
-        try:
-            commit = part.commitment()
-        except (ValueError, TypeError):
-            return PartOutcome(False, fault="undecodable commitment")
-        if commit.t != self.threshold:
-            return PartOutcome(False, fault="wrong degree")
-        if len(part.enc_rows) != len(self.node_ids):
-            return PartOutcome(False, fault="wrong row count")
-        if _tpu_dkg_enabled(self.threshold):
-            # one batched device fold of ALL nodes' COLUMN commitments,
-            # cached on the shared decoded commitment — the first
-            # in-process handler pays, and generate()'s per-proposal
-            # ack-verification folds become lookups (see warm_folds on
-            # why rows stay native)
+        derives its share from t+1 honest ackers' values.
+
+        Batching (round 6): the structural phase and row decryption run
+        sequentially in poll order (duplicate/conflict semantics exactly
+        match the one-at-a-time path), but every decrypted row's
+        RLC/commitment right-hand side settles as ONE batched MSM call
+        on the 16-window short-scalar tier (the LHS stays a host
+        base-point ladder — see the inline note), and the outgoing ack
+        values for every acked part seal through the batched channel
+        plane instead of n host calls per part."""
+        outcomes: List[Optional[PartOutcome]] = [None] * len(items)
+        pending = []  # (slot, proposer idx, state, row, raw, part)
+        mode = _tpu_dkg_mode(self.threshold)
+        for i, (sender_id, part) in enumerate(items):
             try:
-                commit.warm_folds(range(1, len(self.node_ids) + 1))
-            except Exception:  # pragma: no cover - fall back to native
-                pass
-        row: Optional[List[int]] = None
-        fault = None
-        raw = _open(
-            self._chan_key(s),
-            self._row_ctx(s, self.our_idx),
-            bytes(part.enc_rows[self.our_idx]),
-        )
-        if raw is None:
-            fault = "undecryptable row"
-        else:
+                s = self.node_index(sender_id)
+            except ValueError:
+                outcomes[i] = PartOutcome(
+                    False, fault="part from non-member"
+                )
+                continue
+            if s in self.parts:
+                existing = self.parts[s]
+                if existing.commitment.to_bytes() != part.commit_bytes:
+                    outcomes[i] = PartOutcome(
+                        False, fault="conflicting part"
+                    )
+                else:  # duplicate; ack already sent (or pending below)
+                    outcomes[i] = PartOutcome(True)
+                continue
             try:
-                row = [int(c) % R for c in codec.decode(raw)]
+                commit = part.commitment()
             except (ValueError, TypeError):
+                outcomes[i] = PartOutcome(
+                    False, fault="undecodable commitment"
+                )
+                continue
+            if commit.t != self.threshold:
+                outcomes[i] = PartOutcome(False, fault="wrong degree")
+                continue
+            if len(part.enc_rows) != len(self.node_ids):
+                outcomes[i] = PartOutcome(False, fault="wrong row count")
+                continue
+            if mode == "forced":
+                # one batched device fold of ALL nodes' COLUMN
+                # commitments, cached on the shared decoded commitment —
+                # the first in-process handler pays, and generate()'s
+                # per-proposal ack-verification folds become lookups
+                # (see warm_folds on why rows stay native).  Forced mode
+                # only: the all-columns warm pays off when the decoded
+                # commitment is SHARED by every in-process node (the
+                # sim/bench plane).
+                try:
+                    commit.warm_folds(range(1, len(self.node_ids) + 1))
+                except Exception:  # pragma: no cover - native fallback
+                    pass
+            elif mode == "auto":
+                # a real (TCP) validator consumes only ITS OWN column —
+                # warming all n is n× wasted synchronous device work on
+                # the key-gen message path (ADVICE r5)
+                try:
+                    commit.warm_folds([self.our_idx + 1])
+                except Exception:  # pragma: no cover - native fallback
+                    pass
+            row: Optional[List[int]] = None
+            fault = None
+            raw = _open(
+                self._chan_key(s),
+                self._row_ctx(s, self.our_idx),
+                bytes(part.enc_rows[self.our_idx]),
+            )
+            if raw is None:
                 fault = "undecryptable row"
-        if row is not None and len(row) != self.threshold + 1:
-            row, fault = None, "wrong row degree"
-        if row is not None:
-            # one RLC check instead of t+1 point equalities: with random
-            # 64-bit r_k, sum r_k row[k] * G == sum r_k expected[k] —
-            # a forged row passes with probability 2^-64
-            expected = commit.row_commitment(self.our_idx + 1)
+            else:
+                try:
+                    row = [int(c) % R for c in codec.decode(raw)]
+                except (ValueError, TypeError):
+                    fault = "undecryptable row"
+            if row is not None and len(row) != self.threshold + 1:
+                row, fault = None, "wrong row degree"
+            state = _ProposalState(commit, row=row)
+            self.parts[s] = state
+            if row is None:
+                outcomes[i] = PartOutcome(False, fault=fault, recorded=True)
+                continue
+            pending.append((i, s, state, row, raw, part))
+        if not pending:
+            return outcomes  # type: ignore[return-value]
+        # one RLC check per row instead of t+1 point equalities: with
+        # random 64-bit r_k, sum r_k row[k] * G == sum r_k expected[k]
+        # — a forged row passes with probability 2^-64.  All pending
+        # rows' right-hand sides verify as ONE batched MSM.  The LHS
+        # stays a HOST base-point mul on purpose: folding (-lhs)·G1
+        # into the job would smuggle one ~255-bit scalar into an
+        # otherwise 64-bit batch and push the whole MSM onto the
+        # 33-window GLV tier (2x the window work of the 16-window tier
+        # the RLC scalars qualify for); one native G1 ladder per part
+        # is noise next to the t+1-point MSM it gates.
+        jobs = []
+        lhs_points = []
+        for _i, _s, state, row, raw, part in pending:
+            expected = state.commitment.row_commitment(self.our_idx + 1)
             # Fiat-Shamir: the seed hashes the FULL commitment and FULL
             # row — a proposer fixing any prefix and solving for a later
             # coefficient faces fresh scalars
@@ -591,25 +803,36 @@ class SyncKeyGen(Generic[N]):
             ).digest()
             rs = _rlc_scalars(seed, len(row))
             lhs_scalar = sum(r * c for r, c in zip(rs, row)) % R
-            rhs = g1_msm_or_fallback(expected, rs)
-            if not eq(mul_sub(G1, lhs_scalar), rhs):
-                row, fault = None, "row/commitment mismatch"
-        state = _ProposalState(commit, row=row)
-        self.parts[s] = state
-        if row is None:
-            return PartOutcome(False, fault=fault, recorded=True)
-        # our own consistent value: f_s(our_idx+1, our_idx+1)
-        enc_values = []
-        for m, nid in enumerate(self.node_ids):
-            val = poly_eval(row, m + 1)
-            enc_values.append(
-                _seal(
-                    self._chan_key(m),
-                    self._val_ctx(s, self.our_idx, m),
-                    val.to_bytes(32, "big"),
+            jobs.append((list(expected), rs))
+            lhs_points.append(mul_sub(G1, lhs_scalar))
+        results = g1_msm_batch(jobs)
+        acked = []
+        for (i, s, state, row, _raw, _part), res, lhs_pt in zip(
+            pending, results, lhs_points
+        ):
+            if eq(res, lhs_pt):
+                acked.append((i, s, row))
+            else:
+                state.row = None
+                outcomes[i] = PartOutcome(
+                    False, fault="row/commitment mismatch", recorded=True
                 )
+        if acked:
+            self.warm_channel_keys()  # batch any keys still underived
+        for i, s, row in acked:
+            # our own consistent value: f_s(our_idx+1, our_idx+1)
+            enc_values = _seal_batch(
+                [
+                    (
+                        self._chan_key(m),
+                        self._val_ctx(s, self.our_idx, m),
+                        poly_eval(row, m + 1).to_bytes(32, "big"),
+                    )
+                    for m in range(len(self.node_ids))
+                ]
             )
-        return PartOutcome(True, ack=Ack(s, tuple(enc_values)))
+            outcomes[i] = PartOutcome(True, ack=Ack(s, tuple(enc_values)))
+        return outcomes  # type: ignore[return-value]
 
     def handle_ack(self, sender_id: N, ack: Ack) -> AckOutcome:
         """Count an ack.  STRUCTURAL checks (known part, value count,
@@ -649,49 +872,79 @@ class SyncKeyGen(Generic[N]):
         return AckOutcome(True)
 
     def _verify_values(self, state: "_ProposalState") -> None:
-        """Settle a proposal's stored ack values: one RLC check — with
-        random 64-bit r_m,
+        """Single-proposal wrapper over _verify_values_batch."""
+        self._verify_values_batch([state])
+
+    def _verify_values_batch(self, states) -> None:
+        """Settle MANY proposals' stored ack values: per proposal one
+        RLC check — with random 64-bit r_m,
           (sum_m r_m v_m) * G == sum_j col[j] * (sum_m r_m (m+1)^j)
         over the y = our_idx+1 folded column — verifies every value at
-        once (forgery passes with probability 2^-64); on failure, the
+        once (forgery passes with probability 2^-64), and ALL
+        proposals' right-hand sides evaluate as ONE batched MSM call
+        (each job folds its LHS as an extra (-lhs)·G1 term, so success
+        is the identity) instead of n sequential host Pippengers — the
+        per-proposal half of the 128-node era-switch wall.  Unlike the
+        row checks, folding the LHS here is free: the column weights
+        w_j are full-width mod R anyway, so the batch is on the GLV
+        tier with or without the fold.  On a job failure, the
         per-value slow path drops exactly the bad entries."""
-        if getattr(state, "values_verified", True) or not state.values:
-            if not state.values:
-                state.values_verified = True
-            return
-        if state.our_column is None:
-            state.our_column = state.commitment.column_commitment(
-                self.our_idx + 1
-            )
-        items = sorted(state.values.items())  # (m+1, val)
-        # Fiat-Shamir: bind commitment AND every (index, value) pair —
-        # scalars predictable from indices alone would let colluding
-        # ackers send cancelling deviations that pass the batch check
-        h = hashlib.sha256()
-        h.update(b"HBTPU-DKG-ackval")
-        h.update(hashlib.sha256(state.commitment.to_bytes()).digest())
-        for mp, v in items:
-            h.update(mp.to_bytes(4, "big"))
-            h.update(int(v).to_bytes(32, "big"))
-        rs = _rlc_scalars(h.digest(), len(items))
-        lhs = sum(r * v for r, (_mp, v) in zip(rs, items)) % R
-        t1 = len(state.our_column)
-        ws = []
-        for j in range(t1):
-            w = 0
+        pending = []  # (state, items, job points, job scalars)
+        for state in states:
+            if getattr(state, "values_verified", True) or not state.values:
+                if not state.values:
+                    state.values_verified = True
+                continue
+            if state.our_column is None:
+                state.our_column = state.commitment.column_commitment(
+                    self.our_idx + 1
+                )
+            items = sorted(state.values.items())  # (m+1, val)
+            # Fiat-Shamir: bind commitment AND every (index, value) pair
+            # — scalars predictable from indices alone would let
+            # colluding ackers send cancelling deviations that pass the
+            # batch check
+            h = hashlib.sha256()
+            h.update(b"HBTPU-DKG-ackval")
+            h.update(hashlib.sha256(state.commitment.to_bytes()).digest())
+            for mp, v in items:
+                h.update(mp.to_bytes(4, "big"))
+                h.update(int(v).to_bytes(32, "big"))
+            rs = _rlc_scalars(h.digest(), len(items))
+            lhs = sum(r * v for r, (_mp, v) in zip(rs, items)) % R
+            t1 = len(state.our_column)
+            # incremental powers (one modmul per step) instead of a
+            # bigint pow() per (item, j) — ~30M pow calls per 128-node
+            # era switch before round 6
+            ws = [0] * t1
             for r, (mp, _v) in zip(rs, items):
-                w += r * pow(mp, j, R)
-            ws.append(w % R)
-        rhs = g1_msm_or_fallback(state.our_column, ws)
-        if eq(mul_sub(G1, lhs), rhs):
-            state.values_verified = True
+                mpj = 1
+                for j in range(t1):
+                    ws[j] += r * mpj
+                    mpj = mpj * mp % R
+            pending.append(
+                (
+                    state,
+                    items,
+                    list(state.our_column) + [G1],
+                    [w % R for w in ws] + [(R - lhs) % R],
+                )
+            )
+        if not pending:
             return
-        # slow path: drop exactly the mismatching values
-        for mp, val in items:
-            expected = g1_poly_eval(state.our_column, mp)
-            if not eq(mul_sub(G1, val), expected):
-                state.values.pop(mp, None)
-        state.values_verified = True
+        results = g1_msm_batch(
+            [(pts, ks) for _st, _it, pts, ks in pending]
+        )
+        for (state, items, _pts, _ks), res in zip(pending, results):
+            if eq(res, infinity(FQ)):
+                state.values_verified = True
+                continue
+            # slow path: drop exactly the mismatching values
+            for mp, val in items:
+                expected = g1_poly_eval(state.our_column, mp)
+                if not eq(mul_sub(G1, val), expected):
+                    state.values.pop(mp, None)
+            state.values_verified = True
 
     # -- completion ---------------------------------------------------------
 
@@ -712,10 +965,15 @@ class SyncKeyGen(Generic[N]):
         t = self.threshold
         commit_acc = [infinity(FQ) for _ in range(t + 1)]
         sk_val = 0
-        for s, state in sorted(self.parts.items()):
-            if not state.is_complete(t):
-                continue
-            self._verify_values(state)  # settle lazily-stored ack values
+        complete = [
+            state
+            for _s, state in sorted(self.parts.items())
+            if state.is_complete(t)
+        ]
+        # settle ALL proposals' lazily-stored ack values with one
+        # batched MSM call (round 6) instead of one host MSM each
+        self._verify_values_batch(complete)
+        for state in complete:
             row0 = state.commitment.row_commitment(0)
             commit_acc = [add(a, b) for a, b in zip(commit_acc, row0)]
             # interpolate our share slice from VERIFIED ack values only;

@@ -198,6 +198,21 @@ class CpuEngine:
             return nb.g1_mul_batch(points, scalars)
         return [bls.multiply(p, r) for p, r in zip(points, scalars)]
 
+    # -- batched multi-scalar multiplication (the DKG/RLC plane) ------------
+
+    def g1_msm_batch(
+        self, jobs: Sequence[Tuple[Sequence, Sequence[int]]]
+    ) -> List:
+        """Evaluate many INDEPENDENT G1 MSMs: jobs of (points, scalars)
+        -> one combined point per job.  Every RLC right-hand side in the
+        DKG (row checks, ack-value settlement) and any consensus-layer
+        batch verification funnels through this entry point, so the
+        per-job native Pippenger here and the one-dispatch device plane
+        (TpuEngine / ops/msm_T) are interchangeable."""
+        from .dkg import g1_msm_or_fallback
+
+        return [g1_msm_or_fallback(pts, ks) for pts, ks in jobs]
+
     # -- threshold encryption (hbbft::threshold_decrypt) --------------------
 
     def encrypt(self, pk: th.PublicKey, msg: bytes, rng) -> th.Ciphertext:
@@ -441,6 +456,18 @@ class TpuEngine(CpuEngine):
         from ..ops import bls_jax
 
         return bls_jax.g1_scalar_mul_batch(points, scalars)
+
+    def g1_msm_batch(
+        self, jobs: Sequence[Tuple[Sequence, Sequence[int]]]
+    ) -> List:
+        """All jobs' MSMs as ONE device dispatch (ops/msm_T): lanes =
+        (job, point), per-lane windowed ladder + per-job reduction
+        tree; the native Pippenger remains the scalar fallback."""
+        if not jobs:
+            return []
+        from ..ops import msm_T
+
+        return msm_T.g1_msm_batch(jobs)
 
     def sign_share_batch(
         self, items: Sequence[Tuple[th.SecretKeyShare, bytes]]

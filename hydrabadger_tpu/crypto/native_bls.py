@@ -68,6 +68,17 @@ def _load():
             ("bls_g1_mul_glv", [u8p, u8p, u8p, u8p], None),
             ("bls_g1_weighted_sum", [u8p, u8p, i64, i64, u8p], None),
             ("bls_g2_weighted_sum", [u8p, u8p, i64, i64, u8p], None),
+            # the Pippenger MSM + small-base Horner folds (round 3/5
+            # additions) were bound without argtypes — ctypes defaulted
+            # every argument, which happens to work for our call shapes
+            # but silently misconverts if a caller ever passes a plain
+            # int where i64 is expected on an ILP32 ABI
+            ("bls_g1_msm", [u8p, u8p, i64, u8p], None),
+            (
+                "bls_g1_fold_pow",
+                [u8p, i64, i64, ctypes.c_uint64, i64, u8p],
+                None,
+            ),
             ("bls_g1_in_subgroup", [u8p], ctypes.c_int),
             ("bls_g2_in_subgroup", [u8p], ctypes.c_int),
             ("bls_g1_on_curve", [u8p], ctypes.c_int),

@@ -146,6 +146,15 @@ class KeyGenMachine:
             self._drain_pending_acks()
         return outcome
 
+    def handle_parts(self, items: List[tuple]) -> List:
+        """A poll's worth of parts in one call: the underlying
+        SyncKeyGen batches every row RLC check into a single MSM and
+        seals the resulting ack values in one pass (round 6)."""
+        outcomes = self.kg.handle_parts(items)
+        if any(o.valid or o.recorded for o in outcomes):
+            self._drain_pending_acks()
+        return outcomes
+
     def handle_ack(self, sender, ack: Ack):
         if ack.proposer_idx not in self.kg.parts:
             self.pending_acks.append((sender, ack))
@@ -216,6 +225,10 @@ class Hydrabadger:
         # keygen traffic that arrived before our own machine started
         self.keygen_inbox: List[tuple] = []
         self._keygen_inbox_seen: set = set()  # O(1) dedup mirror
+        # poll-scoped keygen part buffer (round 6): non-None only while
+        # the handler loop drains one 50-msg poll — every part in the
+        # poll settles its row RLC check in ONE batched MSM at flush
+        self._kg_poll: Optional[List[tuple]] = None
         self.iom_queue: List[tuple] = []  # messages before DHB exists
         self.batch_queue: asyncio.Queue = asyncio.Queue()
         self.batches: List[DhbBatch] = []
@@ -240,6 +253,7 @@ class Hydrabadger:
         self._epoch_ema_s: Optional[float] = None
         self._last_progress_t = _time.monotonic()
         self._replay_backoff = 1.0
+        self._last_replay_t = 0.0  # monotonic time of the last replay
         self._replayed_since_progress = False
         # user/generator contributions awaiting an epoch whose proposal
         # slot is still free (merged, in order, at the next opportunity)
@@ -490,11 +504,23 @@ class Hydrabadger:
                 # batched check is an optimisation only — on engine
                 # failure fall back to the inline per-frame verify path
                 log.exception("batched signature verification failed")
-            for it in batch:
+            self._kg_poll = []
+            try:
+                for it in batch:
+                    try:
+                        self._handle_internal(it)
+                    except Exception:
+                        log.exception("handler error on %s", it[0])
                 try:
-                    self._handle_internal(it)
+                    self._flush_kg_poll()
                 except Exception:
-                    log.exception("handler error on %s", it[0])
+                    # same containment as the per-item guard: the
+                    # handler coroutine must survive (senders replay
+                    # keygen parts until the DKG completes, so a lost
+                    # flush heals)
+                    log.exception("keygen poll flush failed")
+            finally:
+                self._kg_poll = None
 
     def _preverify_batch(self, batch: List[tuple]) -> None:
         """Amortised wire-signature checks (SURVEY.md §7 hard part 3).
@@ -909,21 +935,70 @@ class Hydrabadger:
         tag = payload[0]
         if tag == "part":
             part = Part(bytes(payload[1]), tuple(bytes(r) for r in payload[2]))
+            if self._kg_poll is not None:
+                # poll-level aggregation: defer to _flush_kg_poll so all
+                # parts of this poll verify as one batched MSM; an ack
+                # racing its part within the same poll already parks in
+                # KeyGenMachine.pending_acks and drains at flush
+                self._kg_poll.append((machine, tuple(instance_id), src, part))
+                return
             outcome = machine.handle_part(src, part)
-            if outcome.valid and outcome.ack is not None:
-                self._broadcast_keygen(
-                    instance_id,
-                    ("ack", outcome.ack.proposer_idx, tuple(outcome.ack.enc_values)),
-                )
-                machine.handle_ack(self.uid.bytes, outcome.ack)
-            elif not outcome.valid:
-                log.warning("keygen part fault from %s: %s", src.hex()[:8], outcome.fault)
+            self._emit_part_outcome(machine, tuple(instance_id), src, outcome)
         elif tag == "ack":
             ack = Ack(int(payload[1]), tuple(bytes(v) for v in payload[2]))
             outcome = machine.handle_ack(src, ack)
             if not outcome.valid:
                 log.warning("keygen ack fault from %s: %s", src.hex()[:8], outcome.fault)
         self._maybe_finish_keygen(machine)
+
+    def _emit_part_outcome(
+        self, machine: KeyGenMachine, instance_id: tuple, src: bytes, outcome
+    ) -> None:
+        """Broadcast/self-handle the ack a handled part produced (or log
+        its fault) — shared by the inline path and the poll flush."""
+        if outcome.valid and outcome.ack is not None:
+            self._broadcast_keygen(
+                instance_id,
+                ("ack", outcome.ack.proposer_idx, tuple(outcome.ack.enc_values)),
+            )
+            machine.handle_ack(self.uid.bytes, outcome.ack)
+        elif not outcome.valid:
+            log.warning(
+                "keygen part fault from %s: %s", src.hex()[:8], outcome.fault
+            )
+
+    def _flush_kg_poll(self) -> None:
+        """Settle the poll's deferred keygen parts per machine: one
+        SyncKeyGen.handle_parts call batches every row RLC check into a
+        single MSM and seals all resulting ack values through the
+        batched channel plane."""
+        buf = self._kg_poll
+        if not buf:
+            return
+        grouped: Dict[int, tuple] = {}
+        for machine, instance_id, src, part in buf:
+            grouped.setdefault(id(machine), (machine, instance_id, []))[
+                2
+            ].append((src, part))
+        for machine, instance_id, items in grouped.values():
+            try:
+                outcomes = machine.handle_parts(items)
+            except Exception:
+                log.exception("keygen poll batch failed")
+                continue
+            for (src, _part), outcome in zip(items, outcomes):
+                # per-item guard, the old inline path's granularity: an
+                # emission error (e.g. a dying transport) must not
+                # abandon the REMAINING acks — a replayed part hits the
+                # duplicate path (ack=None), so a dropped ack would
+                # never regenerate
+                try:
+                    self._emit_part_outcome(machine, instance_id, src, outcome)
+                except Exception:
+                    log.exception(
+                        "keygen ack emit failed for %s", src.hex()[:8]
+                    )
+            self._maybe_finish_keygen(machine)
 
     def _maybe_finish_keygen(self, machine: KeyGenMachine) -> None:
         if machine is None or not machine.is_complete():
@@ -1092,17 +1167,29 @@ class Hydrabadger:
         while self._epoch_outbox and self._epoch_outbox[0][0] < batch.epoch:
             self._epoch_outbox.popleft()
         now = _time.monotonic()
-        dt = now - self._last_progress_t
-        # a stalled epoch's duration must not poison the EMA (it would
-        # raise the next stall's threshold): skip samples from intervals
-        # in which the replay loop fired, and clamp the rest so a single
-        # slow epoch cannot push the threshold beyond ~minutes
-        if not self._replayed_since_progress:
-            dt = min(dt, 60.0)
-            self._epoch_ema_s = (
-                dt if self._epoch_ema_s is None
-                else 0.7 * self._epoch_ema_s + 0.3 * dt
-            )
+        dt = min(now - self._last_progress_t, 60.0)
+        # Clamp so a single slow epoch cannot push the stall threshold
+        # beyond ~minutes.  Replayed intervals fold at REDUCED weight
+        # instead of being skipped (ADVICE r5): with a full skip, a
+        # threshold latched below the true epoch duration (fast warm-up
+        # epochs, then slow full-crypto ones) made EVERY sample a
+        # replayed one, so the EMA could never adapt upward out of the
+        # one-replay-burst-per-epoch state.  The replayed sample is
+        # additionally capped at a small multiple of the CURRENT
+        # estimate: un-latching only needs the EMA to be able to GROW
+        # toward a true duration above it — absorbing the full stall
+        # length would re-inflate the threshold and delay the next
+        # genuine recovery (the original death-spiral ingredient).
+        prev = self._epoch_ema_s
+        if self._replayed_since_progress:
+            # the cap also applies to an UNSEEDED ema (prev None, e.g. a
+            # node booting into a wedged network): seeding with the full
+            # stall length would start the threshold at minutes
+            dt = min(dt, 4.0 * max(prev or 0.0, EPOCH_REPLAY_TICK_S))
+            w = 0.15
+        else:
+            w = 0.3
+        self._epoch_ema_s = dt if prev is None else (1.0 - w) * prev + w * dt
         self._last_progress_t = now
         self._replay_backoff = 1.0
         self._replayed_since_progress = False
@@ -1210,7 +1297,12 @@ class Hydrabadger:
         if era != d.era:
             return
         n = len(d.netinfo.node_ids)
-        if len(entries) > n * (n + 1):  # n parts + n^2 acks, with slack
+        # n parts + n^2 acks + batch-boundary markers; markers are
+        # bounded by TRAFFIC-BEARING BATCHES (worst case one message per
+        # batch, i.e. up to n + n^2 of them), so the honest ceiling is
+        # 2(n + n^2) — an honest transcript must never trip the cap or
+        # the stranded joiner it exists to heal stays stranded
+        if len(entries) > 2 * n * (n + 1):
             return
         # rate-limit only the EXPENSIVE replay, and only after the cheap
         # structural checks — a peer spamming trivially-invalid frames
@@ -1308,10 +1400,20 @@ class Hydrabadger:
             # a genuinely wedged epoch from flooding the wire either.
             ema = self._epoch_ema_s or EPOCH_REPLAY_TICK_S
             threshold = max(3.0 * ema, 2.0 * EPOCH_REPLAY_TICK_S)
-            threshold *= self._replay_backoff
-            if _time.monotonic() - self._last_progress_t < threshold:
+            now = _time.monotonic()
+            if now - self._last_progress_t < threshold:
+                continue
+            # Back off on time since the LAST REPLAY, not since last
+            # progress (ADVICE r5): with the old gate, once a genuinely
+            # wedged epoch stalled past backoff_cap x threshold the
+            # elapsed-since-progress term exceeded it on every tick and
+            # the node reverted to one full outbox replay per second —
+            # the flood the backoff was meant to bound.  Inter-replay
+            # spacing doubles up to 16x regardless of stall age.
+            if now - self._last_replay_t < threshold * self._replay_backoff:
                 continue
             self._replay_backoff = min(self._replay_backoff * 2.0, 16.0)
+            self._last_replay_t = now
             self._replayed_since_progress = True
             frames = list(self._epoch_outbox)
             log.debug(

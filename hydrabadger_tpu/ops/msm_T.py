@@ -1,0 +1,235 @@
+"""Batched independent G1 multi-scalar multiplications — the MSM plane.
+
+The DKG's era-switch verification walls are many SMALL, INDEPENDENT
+MSMs: every part carries a row-RLC check Σ r_k E[k] (t+1 points per
+part, n parts per era) and every complete proposal settles its stored
+ack values with one column-RLC check Σ w_j col[j] (crypto/dkg.py) —
+at the 128-node benchmark scale that is ~16k MSMs of 43-44 points run
+one native Pippenger at a time, the residual wall of the config-5 era
+switch after round 5 batched the commitment folds (vandermonde_T).
+Here a whole batch of B such MSMs evaluates as ONE device program.
+
+Shape: lanes = (job, point) — every s_i · P_i runs as one lane of the
+fq_T windowed ladder ([32, B·S] T layout, whole point ops fused in
+VMEM on TPU), then each job's S partial products collapse through a
+pairwise jac_add tree (log2 S levels, each one batched add over
+B·⌈S/2⌉ lanes).
+
+Why per-lane ladders + a reduction tree and NOT bucketed Pippenger on
+the device: per window, bucket accumulation assigns each point to one
+of 2^w bucket lanes — on a vector unit that is a masked add across ALL
+B·2^w lanes per point, so 2^w − 1 of every 2^w lane-ops are wasted.
+Counting lane-ops at the DKG geometry (S ≈ 43, w = 4, 64-bit RLC
+scalars): bucketing costs B·16 lanes × 16 windows × (S + ~30 running-
+sum adds) ≈ 19k point-ops·lanes per job vs the ladder's B·S lanes ×
+(15-add table + 16×(4 dbl + 1 add)) ≈ 4k — the "asymptotically worse"
+ladder keeps every lane busy and wins ~5×.  Pippenger stays exactly
+where serial hardware wins: the native host fallback
+(crypto/dkg.g1_msm_or_fallback), which is also this kernel's bit-exact
+oracle.
+
+Scalar widths: RLC scalars are 64-bit by construction (dkg._rlc_scalars),
+so the default path runs ⌈max_bits/4⌉ windows instead of a full-width
+ladder; scalars above _SHORT_BITS take the GLV dual-table ladder
+(half-width halves, the production full-width G1 path).
+
+Soundness: MSM inputs here are ATTACKER-CHOSEN commitment points, so
+every add in the ladder and the reduction tree is the COMPLETE
+branch-free body (jac_add_T: doubling arm + infinity masks — see
+vandermonde_T's docstring for why incomplete adds are not safe against
+a proposer who knows its own discrete logs).  Identity points and zero
+scalars are ordinary lanes: z = 0 rides the infinity masks, a zero
+scalar selects table slot 0 (infinity) in every window.
+
+Backend split (the bls_jax.jac_scalar_mul_windowed idiom, for the
+round-3 reason): the T-layout ladder UNROLLS its 15-add table chain —
+one pallas call per add on TPU, but a pathological superlinear compile
+for XLA:CPU — so off-TPU the same math runs through bls_jax's
+scan-built XLA ladders + its [..., S, 3, 32] reduction tree.  Both
+tiers are bit-identical to the host fallback; tests pin the XLA twin in
+tier 1 and force the T path (slow tier) off-hardware.
+
+Results convert to affine on the host (one batched inversion), so the
+returned points are bit-identical to the native Pippenger / plain-sum
+fallback — pinned by tests/test_msm_T.py.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bls12_381 as bls
+from . import fq_T
+from .bls_jax import (
+    BETA_COL,
+    N_LIMBS,
+    _jac_scalar_mul_glv_xla,
+    _jac_scalar_mul_windowed_xla,
+    _reduce_tree,
+    limbs_to_points,
+    points_to_limbs,
+    scalars_to_glv_windows,
+    scalars_to_windows,
+)
+
+# RLC scalars are 64-bit; anything this wide or narrower skips the GLV
+# split and runs ⌈bits/4⌉ plain windows (fewer total point ops than the
+# 33-window dual-table ladder once bits <= ~128)
+_SHORT_BITS = 128
+
+
+def _use_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _reduce_jobs_T(acc, b: int, s: int):
+    """(x, y, z) of [32, B·S] job-major lanes -> [B, 3, 32]: pairwise
+    add tree over each job's S partial products, every level ONE
+    batched complete jac_add over B·⌊S/2⌋ lanes (an odd tail lane is
+    carried to the next level unadded)."""
+    while s > 1:
+        h = s // 2
+        grouped = tuple(a.reshape(N_LIMBS, b, s) for a in acc)
+        left = tuple(
+            g[:, :, :h].reshape(N_LIMBS, b * h) for g in grouped
+        )
+        right = tuple(
+            g[:, :, h : 2 * h].reshape(N_LIMBS, b * h) for g in grouped
+        )
+        merged = fq_T.jac_add_T(left, right)
+        if s % 2:
+            acc = tuple(
+                jnp.concatenate(
+                    [
+                        m.reshape(N_LIMBS, b, h),
+                        g[:, :, 2 * h : s],
+                    ],
+                    axis=2,
+                ).reshape(N_LIMBS, b * (h + 1))
+                for m, g in zip(merged, grouped)
+            )
+            s = h + 1
+        else:
+            acc = merged
+            s = h
+    return fq_T.to_points_BC(acc)
+
+
+@jax.jit
+def _msm_windowed_T(pts: jax.Array, wins: jax.Array) -> jax.Array:
+    """pts: [B, S, 3, 32] Montgomery Jacobian limbs; wins: [B, S, W]
+    MSB-first 4-bit digits -> [B, 3, 32] per-job MSM results (TPU
+    T-layout tier: fused pallas point ops end to end)."""
+    b, s = pts.shape[0], pts.shape[1]
+    lanes = fq_T.from_points_BC(pts.reshape(b * s, 3, N_LIMBS))
+    acc = fq_T.windowed_ladder_T(
+        lanes, jnp.moveaxis(wins.reshape(b * s, -1), -1, 0)
+    )
+    return _reduce_jobs_T(acc, b, s)
+
+
+@jax.jit
+def _msm_glv_T(pts: jax.Array, w1: jax.Array, w2: jax.Array) -> jax.Array:
+    """Full-width-scalar variant: the GLV dual-table ladder per lane.
+    w1/w2: [B, S, 33] MSB-first 4-bit digits of the half-width split."""
+    b, s = pts.shape[0], pts.shape[1]
+    lanes = fq_T.from_points_BC(pts.reshape(b * s, 3, N_LIMBS))
+    acc = fq_T.glv_ladder_T(
+        lanes,
+        jnp.moveaxis(w1.reshape(b * s, -1), -1, 0),
+        jnp.moveaxis(w2.reshape(b * s, -1), -1, 0),
+        jnp.asarray(BETA_COL),
+    )
+    return _reduce_jobs_T(acc, b, s)
+
+
+@jax.jit
+def _msm_windowed_xla(pts: jax.Array, wins: jax.Array) -> jax.Array:
+    """XLA:CPU twin: scan-built per-lane ladder + the bls_jax reduction
+    tree over each job's S partial products."""
+    return _reduce_tree(_jac_scalar_mul_windowed_xla(pts, wins))
+
+
+@jax.jit
+def _msm_glv_xla(pts: jax.Array, w1: jax.Array, w2: jax.Array) -> jax.Array:
+    return _reduce_tree(_jac_scalar_mul_glv_xla(pts, w1, w2))
+
+
+def _bucket(n: int, floor: int = 1) -> int:
+    """Round a batch dimension up to the next {2^k, 1.5·2^k} bucket so
+    varying poll sizes reuse a handful of compiled shapes (a fresh
+    XLA:CPU trace of the ladder costs ~a minute; padding a 44-point DKG
+    job to 48 lanes costs 9%)."""
+    n = max(n, floor)
+    p = 1
+    while p < n:
+        if p + p // 2 >= n > p:
+            return p + p // 2
+        p *= 2
+    return p
+
+
+def _pack_jobs(
+    jobs: Sequence[Tuple[Sequence, Sequence[int]]]
+) -> Tuple[np.ndarray, List[int], int, int]:
+    """Pad every job to the batch's bucketed max size with (infinity, 0)
+    lanes — both are identity elements of the ladder, so padding never
+    changes a job's sum — pad the job axis to its bucket with all-
+    identity jobs, and pack points to [B, S, 3, 32] limbs."""
+    b = _bucket(len(jobs), floor=4)
+    s = _bucket(max(1, max(len(pts) for pts, _ks in jobs)))
+    inf = bls.infinity(bls.FQ)
+    flat_pts: List = []
+    flat_ks: List[int] = []
+    for pts, ks in jobs:
+        if len(pts) != len(ks):
+            raise ValueError("points/scalars length mismatch")
+        pad = s - len(pts)
+        flat_pts.extend(list(pts))
+        flat_pts.extend([inf] * pad)
+        flat_ks.extend(int(k) % bls.R for k in ks)
+        flat_ks.extend([0] * pad)
+    for _ in range(b - len(jobs)):
+        flat_pts.extend([inf] * s)
+        flat_ks.extend([0] * s)
+    limbs = points_to_limbs(flat_pts).reshape(b, s, 3, N_LIMBS)
+    return limbs, flat_ks, b, s
+
+
+def g1_msm_batch(
+    jobs: Sequence[Tuple[Sequence, Sequence[int]]]
+) -> List:
+    """Evaluate B independent MSMs Σ_i ks[i]·pts[i] in one dispatch.
+
+    `jobs`: sequence of (points, scalars) pairs — CPU projective point
+    tuples and Python ints; jobs may be ragged (padded internally).
+    Returns one combined CPU point per job, bit-identical to
+    crypto/dkg.g1_msm_or_fallback per job.
+    """
+    if not jobs:
+        return []
+    n_jobs = len(jobs)
+    limbs, flat_ks, b, s = _pack_jobs(jobs)
+    tpu = _use_tpu()
+    max_bits = max([k.bit_length() for k in flat_ks] + [1])
+    if max_bits <= _SHORT_BITS:
+        # bucket the window count so batches whose max scalar width
+        # jitters by a few bits share a compiled shape
+        n_win = _bucket(-(-max_bits // 4), floor=4)
+        wins = scalars_to_windows(flat_ks, n_bits=4 * n_win)
+        fn = _msm_windowed_T if tpu else _msm_windowed_xla
+        out = fn(
+            jnp.asarray(limbs), jnp.asarray(wins.reshape(b, s, n_win))
+        )
+    else:
+        w1, w2 = scalars_to_glv_windows(flat_ks)
+        fn = _msm_glv_T if tpu else _msm_glv_xla
+        out = fn(
+            jnp.asarray(limbs),
+            jnp.asarray(w1.reshape(b, s, -1)),
+            jnp.asarray(w2.reshape(b, s, -1)),
+        )
+    return limbs_to_points(out)[:n_jobs]

@@ -34,6 +34,20 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "nodes") -> Mesh:
     return Mesh(np.array(devices[:n]), (axis,))
 
 
+def _shard_map(**kw):
+    """`jax.shard_map(...)` partial, tolerant of the API's move out of
+    jax.experimental: older jax spells it
+    jax.experimental.shard_map.shard_map and calls the varying-mesh-axis
+    check `check_rep` instead of `check_vma`."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+    return partial(sm, **kw)
+
+
 def broadcast_round_sharded(
     proposals: jax.Array,
     data_shards: int,
@@ -70,8 +84,7 @@ def broadcast_round_sharded(
         rs_jax._decode_mat(data_shards, parity_shards, dec_rows)
     ))
 
-    @partial(
-        jax.shard_map,
+    @_shard_map(
         mesh=mesh,
         in_specs=(P(axis), P(None), P(None)),
         # received: [proposer, shard-column, L] with shard columns
@@ -128,8 +141,7 @@ def instances_sharded_encode(
         )
     )
 
-    @partial(
-        jax.shard_map,
+    @_shard_map(
         mesh=mesh,
         in_specs=(P(axis), P(None)),
         out_specs=P(axis),
@@ -200,8 +212,7 @@ def full_crypto_epoch_node_sharded(mesh: Mesh, n_nodes: int = 64) -> bool:
     axis = mesh.axis_names[0]
     body = build_full_crypto_epoch(1, n_loc, cfg.threshold, 1)
 
-    @partial(
-        jax.shard_map,
+    @_shard_map(
         mesh=mesh,
         in_specs=(P(None, axis), P(None), P(None), P(None), P(None),
                   P(None), P(None)),

@@ -13,8 +13,10 @@ against.
 """
 from __future__ import annotations
 
+import os
 import random
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -52,6 +54,29 @@ class SimConfig:
     # it when built and the epoch is eligible: fast crypto tier, hash
     # coin, no adversary); True = require; False = always Python cores
     native_acs: Optional[bool] = None
+    # era-switch DKG crypto plane (crypto/dkg HYDRABADGER_TPU_DKG):
+    # None = inherit the ambient env; True/False = force the flag for
+    # the duration of each run_epoch and RESTORE it afterwards, so a
+    # bench/test toggling the plane cannot leak it process-wide into
+    # later configs (ADVICE r5 / the bench.py:328 leak)
+    tpu_dkg: Optional[bool] = None
+
+
+@contextmanager
+def _dkg_plane(flag: Optional[bool]):
+    """Scoped HYDRABADGER_TPU_DKG override (see SimConfig.tpu_dkg)."""
+    if flag is None:
+        yield
+        return
+    prev = os.environ.get("HYDRABADGER_TPU_DKG")
+    os.environ["HYDRABADGER_TPU_DKG"] = "1" if flag else "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("HYDRABADGER_TPU_DKG", None)
+        else:
+            os.environ["HYDRABADGER_TPU_DKG"] = prev
 
 
 @dataclass
@@ -271,6 +296,12 @@ class SimNetwork:
 
     def run_epoch(self) -> None:
         """Generate workload, propose everywhere, run to quiescence."""
+        # getattr: SimConfig instances unpickled from pre-round-6
+        # checkpoints predate the field (see __setstate__)
+        with _dkg_plane(getattr(self.cfg, "tpu_dkg", None)):
+            self._run_epoch_inner()
+
+    def _run_epoch_inner(self) -> None:
         t0 = time.perf_counter()
         cfg = self.cfg
         if self._native_eligible():

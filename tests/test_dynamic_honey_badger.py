@@ -441,3 +441,35 @@ def test_leaver_tracker_matches_validators_under_bad_part():
     assert len(pk_sets) == 1
     assert not dhbs[leaver].is_validator
     assert leaver not in dhbs[ids[0]].netinfo.node_ids
+
+
+def test_attacker_sent_batch_marker_not_recorded():
+    """The transcript's "batch" boundary markers are OUT-OF-BAND schedule
+    data appended by _on_batch; a Byzantine validator SENDING ("batch",)
+    as a keygen message must be faulted and kept out of the transcript,
+    or it could inject an early part-flush into every future replayer's
+    schedule and desync it from the live era-switch gate."""
+    from hydrabadger_tpu.consensus.types import Step
+    from hydrabadger_tpu.sim.router import Router
+
+    ids, _, _, dhbs = make_cluster(4)
+    router = Router(ids, lambda me, s, m: dhbs[me].handle_message(s, m))
+    rng = random.Random(11)
+    for i in ids:
+        dhbs[i].vote_to_remove(ids[-1])
+    _pump_until(
+        router, dhbs, rng,
+        lambda: dhbs[ids[0]].key_gen is not None,
+    )
+    d = dhbs[ids[0]]
+    state = d.key_gen
+    assert state is not None
+    before = len(state.transcript)
+    step = Step()
+    d._commit_keygen_msg(ids[1], ("batch",), step)
+    assert len(state.transcript) == before, "marker recorded from the wire"
+    assert any("unknown keygen" in f.kind for f in step.fault_log)
+    # genuine part/ack traffic IS recorded (the normal transcript path)
+    assert any(
+        e[1][0] in ("part", "ack") for e in state.transcript
+    ) or before == 0

@@ -98,3 +98,26 @@ def test_sim_runs_on_tpu_engine():
     assert m_cpu.agreement_ok and m_tpu.agreement_ok
     assert m_cpu.epochs_done == m_tpu.epochs_done
     assert m_cpu.txns_committed == m_tpu.txns_committed
+
+
+def test_g1_msm_batch_both_engines_match_fallback():
+    """The MSM plane entry point: CpuEngine loops the native Pippenger
+    per job, TpuEngine runs one device dispatch (on this host: the
+    XLA:CPU twin) — both must be point-identical to the shared
+    fallback.  Geometry mirrors the tier-1 msm_T shape bucket (size
+    <= 4, 64-bit scalars) so the device compile is shared."""
+    from hydrabadger_tpu.crypto import bls12_381 as bls
+    from hydrabadger_tpu.crypto.dkg import g1_msm_or_fallback
+
+    rng = random.Random(3)
+    jobs = []
+    for size in (4, 2, 3):
+        pts = [bls.mul_sub(bls.G1, rng.getrandbits(180) | 1) for _ in range(size)]
+        ks = [rng.getrandbits(64) | 1 for _ in range(size)]
+        jobs.append((pts, ks))
+    jobs[0][1][0] |= 1 << 63  # pin the 64-bit window tier
+    want = [g1_msm_or_fallback(p, s) for p, s in jobs]
+    for eng in (get_engine("cpu"), get_engine("tpu")):
+        got = eng.g1_msm_batch(jobs)
+        assert all(bls.eq(g, w) for g, w in zip(got, want))
+    assert get_engine("tpu").g1_msm_batch([]) == []

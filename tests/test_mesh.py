@@ -84,6 +84,20 @@ def test_broadcast_round_sharded_64node_geometry():
 
 
 @pytest.mark.slow
+def test_full_crypto_epoch_instance_sharded_64node_geometry():
+    """Round 6 (ADVICE r5): the 64-node INSTANCE-sharded full-crypto leg,
+    restored at reduced instances (8 = one per device).  Round 5 swapped
+    it for the node-sharded form below, which left instance-shard shape
+    bugs at the large-quorum benchmark geometry invisible before a real
+    chip run; one instance per device keeps the ladder budget sane
+    (~5 min on the 8-virtual-device CPU mesh)."""
+    from hydrabadger_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh(8)
+    assert pmesh.full_crypto_epoch_sharded(mesh, n_nodes=64, instances=8)
+
+
+@pytest.mark.slow
 def test_full_crypto_epoch_sharded_64node_geometry():
     """A 64-node (threshold 21, quorum 22) full-crypto epoch NODE-
     sharded across the mesh under shard_map — the config-8 benchmark

@@ -1,0 +1,83 @@
+"""Batched MSM plane (ops/msm_T) vs the native Pippenger / plain-sum
+fallback — point identity on randomized and edge-case job batches.
+
+Every tier-1 test shares ONE compiled shape (job-size bucket 4, 64-bit
+scalar tier, batch bucket 4) so the XLA:CPU twin compiles once for the
+whole file; the GLV full-width tier adds a compile and rides the slow
+tier.  The TPU T-layout tier cannot be forced off-hardware at sane cost
+(its unrolled table chain is a ~10-minute XLA:CPU compile — the exact
+reason the CPU twin exists); it is pinned against the native Pippenger
+at RUNTIME by the bench micro-row's point-identity assert
+(bench._msm_batch_microrow) on every TPU capture.
+"""
+import random
+
+import pytest
+
+from hydrabadger_tpu.crypto import bls12_381 as bls
+from hydrabadger_tpu.crypto.dkg import g1_msm_or_fallback
+from hydrabadger_tpu.ops import msm_T
+
+# a 64-bit scalar with the top bit pinned: every batch that includes it
+# lands in the same bucketed window tier (16 windows)
+TOP64 = (1 << 63) | 0x5DEECE66D
+
+
+def pt(k):
+    return bls.mul_sub(bls.G1, k)
+
+
+def check(jobs):
+    got = msm_T.g1_msm_batch(jobs)
+    assert len(got) == len(jobs)
+    for g, (pts, ks) in zip(got, jobs):
+        assert bls.eq(g, g1_msm_or_fallback(pts, ks))
+
+
+def test_random_jobs_match_native():
+    rng = random.Random(42)
+    jobs = []
+    for size in (4, 3, 2, 1):
+        pts = [pt(rng.getrandbits(200) | 1) for _ in range(size)]
+        ks = [rng.getrandbits(64) | 1 for _ in range(size)]
+        jobs.append((pts, ks))
+    jobs[0][1][0] = TOP64
+    check(jobs)
+
+
+def test_identity_points_and_zero_scalars():
+    inf = bls.infinity(bls.FQ)
+    jobs = [
+        ([inf, pt(7), inf, pt(9)], [TOP64, 5, 3, 0]),
+        ([inf], [TOP64]),
+        ([pt(11), pt(12)], [0, 0]),
+    ]
+    check(jobs)
+
+
+def test_batch_of_one_and_ragged_empty_job():
+    check([([pt(3), pt(4), pt(5), pt(6)], [TOP64, 2, 3, 4])])
+    # an empty job pads to all-identity lanes and sums to infinity
+    full = ([pt(2), pt(3), pt(4), pt(5)], [TOP64, 1, 2, 3])
+    got = msm_T.g1_msm_batch([([], []), full])
+    assert bls.is_inf(got[0])
+    assert bls.eq(got[1], g1_msm_or_fallback(*full))
+
+
+def test_empty_batch_and_length_mismatch():
+    assert msm_T.g1_msm_batch([]) == []
+    with pytest.raises(ValueError):
+        msm_T.g1_msm_batch([([pt(1)], [1, 2])])
+
+
+@pytest.mark.slow
+def test_full_width_scalars_take_glv_tier():
+    rng = random.Random(7)
+    jobs = [
+        (
+            [pt(i + 2) for i in range(3)],
+            [rng.getrandbits(255) % bls.R for _ in range(3)],
+        ),
+        ([pt(9)], [bls.R - 1]),
+    ]
+    check(jobs)

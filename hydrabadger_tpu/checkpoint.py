@@ -141,6 +141,11 @@ class NodeCheckpoint:
     session_id: bytes = b"dhb"  # coin/session binding; must match peers
 
     def to_bytes(self) -> bytes:
+        # The checkpoint IS the durable key store: the module docstring
+        # pins that a checkpoint is as secret as the keys themselves and
+        # must never leave the operator's trust domain (optionally
+        # HMAC'd via HYDRABADGER_CKPT_KEY).
+        # hblint: disable=secret-taint -- checkpoint is the intended durable key store; file-level protection is the operator's contract (module docstring)
         payload = codec.encode(
             (
                 _NODE_VERSION,

@@ -224,6 +224,12 @@ class SecretKey:
     def to_bytes(self) -> bytes:
         return self.scalar.to_bytes(32, "big")
 
+    def __repr__(self) -> str:
+        # NEVER the scalar: a dataclass default repr would print the
+        # key into any '%s' / f-string that touches the object
+        # (lint: secret-taint class hygiene)
+        return f"<{type(self).__name__} [redacted]>"
+
     def public_key(self) -> PublicKey:
         return PublicKey(mul_sub(G1, self.scalar))
 
@@ -328,6 +334,10 @@ class SecretKeySet:
 
     def __init__(self, coeffs: Sequence[int]):
         self.coeffs = [c % R for c in coeffs]
+
+    def __repr__(self) -> str:
+        # coefficients ARE the master secret; repr only the degree
+        return f"<SecretKeySet t={self.threshold} [redacted]>"
 
     @classmethod
     def random(cls, threshold: int, rng) -> "SecretKeySet":

@@ -23,8 +23,31 @@ violation corrupts consensus safety or TPU lowering:
     coverage is pinned by the paired runtime round-trip test in
     ``tests/test_codec.py`` via :func:`lint.wire_contract.sample_messages`.)
 
+On top of the per-file rules, three INTERPROCEDURAL dataflow passes
+(hbtaint) share a package-wide call graph (``lint/callgraph.py``) and a
+policy-driven abstract interpreter (``lint/dataflow.py``):
+
+  * **attacker-taint** — wire-decoded/router-delivered data must pass a
+    registered sanitizer (cap guard, clamp, bounded slice, shape
+    bucket) before driving loop bounds, container growth or jit entry
+    shapes (``lint/taint.py``);
+  * **secret-taint** — key material (DKG shares, channel keys, identity
+    scalars) must never reach logging, exception strings, ``repr``,
+    or serialization unsealed (``lint/secrets.py``);
+  * **retrace-budget** — every jit entrypoint's signature set is
+    declared and statically bounded: bucket-fed via a module
+    ``RETRACE_BUDGETS`` table or config-bounded via
+    ``lint/registry.py:CONFIG_BOUNDED_JIT`` (``lint/retrace_budget.py``).
+
+Everything the passes treat as special is declared in
+``lint/registry.py`` — the auditable contract surface.
+
 Run with ``python -m hydrabadger_tpu.lint``; exits nonzero on any
 unsuppressed finding and prints ``file:line: rule: message`` diagnostics.
+``--json`` emits a machine-readable report; the checked-in
+``lint-baseline.json`` makes CI fail on NEW findings/suppressions while
+grandfathered ones stay auditable (``--write-baseline`` updates it);
+``--changed`` is the git-diff-scoped fast path.
 
 Suppression syntax (per line, justification MANDATORY)::
 
@@ -85,9 +108,9 @@ class SourceFile:
         return Finding(rule=rule, path=shown, line=line, message=message)
 
 
-def _suppressions(sf: SourceFile) -> Tuple[Dict[int, set], List[Finding]]:
-    """Map line -> suppressed rule names; malformed pragmas are findings."""
-    by_line: Dict[int, set] = {}
+def _suppressions(sf: SourceFile) -> Tuple[Dict[int, Dict[str, str]], List[Finding]]:
+    """Map line -> {rule: justification}; malformed pragmas are findings."""
+    by_line: Dict[int, Dict[str, str]] = {}
     bad: List[Finding] = []
     for i, raw in enumerate(sf.lines, start=1):
         if "hblint" not in raw:
@@ -111,16 +134,28 @@ def _suppressions(sf: SourceFile) -> Tuple[Dict[int, set], List[Finding]]:
             )
             continue
         target = i + 1 if raw.lstrip().startswith("#") else i
-        by_line.setdefault(target, set()).update(rules)
+        slot = by_line.setdefault(target, {})
+        for r in rules:
+            slot[r] = justification
     return by_line, bad
 
 
 def all_rules():
     """The rule registry, in report order."""
     from . import deadcode, jit_hygiene, limb_layout, mosaic, sansio
-    from . import wire_contract
+    from . import retrace_budget, secrets, taint, wire_contract
 
-    return [sansio, mosaic, jit_hygiene, limb_layout, wire_contract, deadcode]
+    return [
+        sansio,
+        mosaic,
+        jit_hygiene,
+        limb_layout,
+        wire_contract,
+        taint,
+        secrets,
+        retrace_budget,
+        deadcode,
+    ]
 
 
 def iter_sources(root: Path = PACKAGE_ROOT) -> Iterable[SourceFile]:
@@ -128,14 +163,18 @@ def iter_sources(root: Path = PACKAGE_ROOT) -> Iterable[SourceFile]:
         yield SourceFile.load(path, root)
 
 
-def run(
+def run_full(
     root: Path = PACKAGE_ROOT,
     rules: Optional[Sequence] = None,
     files: Optional[Sequence[Path]] = None,
-) -> Tuple[List[Finding], int]:
+) -> Tuple[List[Finding], List[Tuple[Finding, str]]]:
     """Run ``rules`` over ``root`` (or explicit ``files``).
 
-    Returns ``(unsuppressed findings, suppressed count)``.
+    Returns ``(unsuppressed findings, [(suppressed finding,
+    justification)])``.  Suppressions are matched package-wide by
+    (shown path, line): the dataflow passes emit findings for files
+    other than the one they anchor on, and the pragma lives next to the
+    flagged statement, wherever that is.
     """
     selected = list(rules) if rules is not None else all_rules()
     sources = (
@@ -144,21 +183,44 @@ def run(
         else list(iter_sources(root))
     )
     findings: List[Finding] = []
-    suppressed = 0
-    for sf in sources:
+    suppressed: List[Tuple[Finding, str]] = []
+    # package-wide suppression index keyed by the shown (display) path
+    # (always PACKAGE_ROOT.name-prefixed, matching SourceFile.finding)
+    index: Dict[str, Dict[int, Dict[str, str]]] = {}
+    scan = sources if files is None else list(iter_sources(root))
+    selected_paths = {sf.relpath for sf in sources}
+    for sf in scan:
         by_line, bad = _suppressions(sf)
-        findings.extend(bad)
+        shown = (Path(PACKAGE_ROOT.name) / sf.relpath).as_posix()
+        index[shown] = by_line
+        if sf.relpath in selected_paths:
+            findings.extend(bad)
+    raw: List[Finding] = []
+    for sf in sources:
         for rule in selected:
             applies = getattr(rule, "applies", None)
             if applies is not None and not applies(sf.relpath):
                 continue
-            for f in rule.check(sf):
-                if rule.RULE in by_line.get(f.line, ()):
-                    suppressed += 1
-                else:
-                    findings.append(f)
+            raw.extend(rule.check(sf))
+    for f in raw:
+        just = index.get(f.path, {}).get(f.line, {}).get(f.rule)
+        if just is not None:
+            suppressed.append((f, just))
+        else:
+            findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    suppressed.sort(key=lambda fj: (fj[0].path, fj[0].line, fj[0].rule))
     return findings, suppressed
+
+
+def run(
+    root: Path = PACKAGE_ROOT,
+    rules: Optional[Sequence] = None,
+    files: Optional[Sequence[Path]] = None,
+) -> Tuple[List[Finding], int]:
+    """Compatibility wrapper: ``(findings, suppressed count)``."""
+    findings, suppressed = run_full(root, rules, files)
+    return findings, len(suppressed)
 
 
 # -- shared AST helpers ------------------------------------------------------

@@ -2,21 +2,101 @@
 
 Exits 0 when every finding is suppressed-with-justification or absent;
 nonzero otherwise.  Diagnostics are ``file:line: rule: message``.
+
+Baseline workflow (CI): when ``lint-baseline.json`` exists at the repo
+root (or ``--baseline PATH`` is given) the run fails on findings or
+suppressions that are NOT in the baseline — new findings must be fixed
+and new suppressions must be consciously audited into the baseline via
+``--write-baseline``.  Grandfathered entries are reported but pass, so
+the debt stays visible without blocking unrelated work.
+
+``--changed`` is the fast pre-commit path: per-file rules run only on
+the files ``git diff`` reports (the whole-package dataflow passes run
+only if the diff touches the package root or ``lint/`` itself); CI and
+``scripts/test-all`` run the full analyzer.
 """
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 from pathlib import Path
 
-from . import PACKAGE_ROOT, all_rules, run
+from . import PACKAGE_ROOT, all_rules, run_full
+
+DEFAULT_BASELINE = PACKAGE_ROOT.parent / "lint-baseline.json"
+
+
+def _finding_key(f) -> tuple:
+    # line-free so ordinary edits above a grandfathered site don't
+    # invalidate the baseline
+    return (f.rule, f.path, f.message)
+
+
+def _suppression_key(f, justification: str) -> tuple:
+    return (f.rule, f.path, justification)
+
+
+def _snapshot(findings, suppressed) -> dict:
+    return {
+        "findings": [
+            {"rule": f.rule, "path": f.path, "message": f.message}
+            for f in findings
+        ],
+        "suppressed": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "justification": j,
+                "message": f.message,
+            }
+            for f, j in suppressed
+        ],
+    }
+
+
+def _changed_files() -> list:
+    """Package .py files the git diff (incl. untracked) touches.
+
+    When the diff touches ``lint/`` or the package ``__init__.py``, the
+    anchor file is added so the whole-package dataflow passes run too —
+    an edit to the analyzer must re-run the analyzer."""
+    root = PACKAGE_ROOT.parent
+    out = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                cmd, capture_output=True, text=True, cwd=root, check=False
+            )
+        except OSError:
+            continue
+        for line in res.stdout.splitlines():
+            p = root / line.strip()
+            if (
+                line.strip().startswith(f"{PACKAGE_ROOT.name}/")
+                and p.suffix == ".py"
+                and p.exists()
+            ):
+                out.add(p)
+    anchor = PACKAGE_ROOT / "__init__.py"
+    if any(
+        p == anchor or p.is_relative_to(PACKAGE_ROOT / "lint") for p in out
+    ):
+        out.add(anchor)
+    return sorted(out)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m hydrabadger_tpu.lint",
-        description="repo-native static analysis for the sans-io, Mosaic, "
-        "jit-hygiene, limb-layout and wire-exhaustiveness contracts",
+        description="repo-native static analysis: the per-file contract "
+        "rules (sans-io, Mosaic, jit-hygiene, limb-layout, "
+        "wire-exhaustiveness, dead-code) plus the interprocedural "
+        "dataflow passes (attacker-taint, secret-taint, retrace-budget)",
     )
     parser.add_argument(
         "files",
@@ -30,6 +110,35 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit findings + suppressions as JSON on stdout",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="PATH",
+        help="compare against a baseline snapshot (default: "
+        "lint-baseline.json at the repo root, when present)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline file (report raw findings only)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        nargs="?",
+        const=str(DEFAULT_BASELINE),
+        metavar="PATH",
+        help="write the current findings+suppressions as the new "
+        "baseline and exit 0",
+    )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="fast path: lint only git-changed package files",
     )
     parser.add_argument(
         "-q", "--quiet", action="store_true", help="suppress the summary line"
@@ -51,17 +160,104 @@ def main(argv=None) -> int:
         rules = [known[r] for r in args.rule]
 
     files = [Path(f) for f in args.files] or None
-    findings, suppressed = run(rules=rules, files=files)
-    for f in findings:
-        print(f.render())
-    if not args.quiet:
-        noun = "finding" if len(findings) == 1 else "findings"
-        print(
-            f"hblint: {len(findings)} {noun} "
-            f"({suppressed} suppressed with justification) across "
-            f"{len(rules)} rule(s) in {PACKAGE_ROOT.name}/"
+    if args.changed and files is None:
+        files = _changed_files()
+        if not files:
+            if not args.quiet:
+                print("hblint: no changed package files")
+            return 0
+
+    findings, suppressed = run_full(rules=rules, files=files)
+
+    if args.write_baseline is not None:
+        if files is not None:
+            # a file-scoped run sees only a slice of the findings; writing
+            # it would silently drop every other file's grandfathered
+            # entries and break the next full CI run
+            print(
+                "hblint: refusing to write a baseline from a file-scoped "
+                "run — drop --changed / file arguments first",
+                file=sys.stderr,
+            )
+            return 2
+        snap = _snapshot(findings, suppressed)
+        Path(args.write_baseline).write_text(
+            json.dumps(snap, indent=2, sort_keys=True) + "\n"
         )
-    return 1 if findings else 0
+        if not args.quiet:
+            print(
+                f"hblint: baseline written to {args.write_baseline} "
+                f"({len(findings)} findings, {len(suppressed)} suppressions)"
+            )
+        return 0
+
+    baseline = None
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if DEFAULT_BASELINE.exists() else None
+    )
+    # applied in every mode (incl. --changed / explicit files):
+    # matching is (rule, path, message)-keyed, so a file-scoped run
+    # grandfathers exactly what full CI grandfathers
+    if baseline_path is not None and not args.no_baseline:
+        try:
+            baseline = json.loads(Path(baseline_path).read_text())
+        except (OSError, ValueError) as e:
+            print(f"hblint: unreadable baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    new_suppressions = []
+    grandfathered = []
+    fail_findings = findings
+    if baseline is not None:
+        known_f = {
+            (e["rule"], e["path"], e["message"])
+            for e in baseline.get("findings", [])
+        }
+        known_s = {
+            (e["rule"], e["path"], e["justification"])
+            for e in baseline.get("suppressed", [])
+        }
+        grandfathered = [f for f in findings if _finding_key(f) in known_f]
+        fail_findings = [f for f in findings if _finding_key(f) not in known_f]
+        new_suppressions = [
+            (f, j)
+            for f, j in suppressed
+            if _suppression_key(f, j) not in known_s
+        ]
+
+    if args.json:
+        snap = _snapshot(fail_findings, suppressed)
+        snap["grandfathered"] = [
+            {"rule": f.rule, "path": f.path, "message": f.message}
+            for f in grandfathered
+        ]
+        snap["new_suppressions"] = [
+            {"rule": f.rule, "path": f.path, "justification": j}
+            for f, j in new_suppressions
+        ]
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    else:
+        for f in fail_findings:
+            print(f.render())
+        for f in grandfathered:
+            print(f"{f.render()}  [grandfathered]")
+        for f, j in new_suppressions:
+            print(
+                f"{f.path}:{f.line}: {f.rule}: NEW suppression "
+                f"({j!r}) — audit it, then `--write-baseline`"
+            )
+    if not args.quiet and not args.json:
+        noun = "finding" if len(fail_findings) == 1 else "findings"
+        extra = (
+            f", {len(grandfathered)} grandfathered" if grandfathered else ""
+        )
+        print(
+            f"hblint: {len(fail_findings)} {noun} "
+            f"({len(suppressed)} suppressed with justification{extra}) "
+            f"across {len(rules)} rule(s) in {PACKAGE_ROOT.name}/"
+        )
+    return 1 if (fail_findings or new_suppressions) else 0
 
 
 if __name__ == "__main__":

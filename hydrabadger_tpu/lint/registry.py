@@ -1,0 +1,265 @@
+"""The sanitizer / source / secret registry the dataflow passes consult.
+
+Everything the interprocedural passes treat as special is DECLARED here
+(or, for retrace budgets, in the target module's ``RETRACE_BUDGETS``
+dict) rather than hard-coded in the analysis — the registry is the
+auditable contract surface: adding a new wire-decode entry point, a new
+shape bucket, or a new secret-bearing class is a one-line diff that the
+reviewer sees next to the code it blesses.
+
+Three registries:
+
+* **attacker-taint** (`lint/taint.py`): where adversary-controlled data
+  enters (``TAINT_SOURCE_CALLS`` / ``TAINT_SOURCE_ATTRS`` /
+  ``TAINT_SOURCE_PARAMS``) and which operations launder it
+  (``CLAMP_FUNCS`` — value clamps; structural ``len()``-guard
+  recognition lives in lint/dataflow.py).
+* **secret-taint** (`lint/secrets.py`): which names/classes carry key
+  material (``SECRET_NAME_TOKENS`` / ``SECRET_CLASSES``) and which
+  calls consume it legitimately (``SECRET_SEAL_FUNCS``).
+* **retrace-budget** (`lint/retrace_budget.py`): which functions bucket
+  a shape dimension (``SHAPE_BUCKET_FUNCS``), which helpers are
+  declared shape-sanitizing end to end (``SANITIZING_FUNCS`` — the pass
+  verifies each one really calls a bucket), and which jit entrypoints
+  have dims bounded by fixed process config instead of buckets
+  (``CONFIG_BOUNDED_JIT`` — each entry carries its justification, the
+  checked replacement for a comment).
+"""
+from __future__ import annotations
+
+# --------------------------------------------------------------------------
+# attacker-taint sources
+# --------------------------------------------------------------------------
+
+# Calls whose RETURN VALUE is attacker-controlled, matched on the dotted
+# call name's suffix (``codec.decode`` matches ``codec.decode(...)`` and
+# ``utils.codec.decode(...)``).
+TAINT_SOURCE_CALLS = frozenset(
+    {
+        "codec.decode",
+        "WireMessage.decode",
+    }
+)
+
+# Methods whose return value is attacker-controlled wherever the
+# receiver object came from (resolved by bare method name — these names
+# are unique to the wire/router planes).
+TAINT_SOURCE_METHODS = frozenset(
+    {
+        "recv",  # WireStream.recv: (message, body, signature) off a socket
+    }
+)
+
+# Attribute reads that yield attacker-controlled data regardless of the
+# base object's taint (a WireMessage's payload is raw decoded bytes even
+# when the message variable itself is untracked).
+TAINT_SOURCE_ATTRS = frozenset({"payload", "enc_rows", "enc_values", "commit_bytes"})
+
+# Parameters seeded tainted: (relpath, function name, parameter).
+# These are the entry points where wire/router deliveries surface as
+# plain arguments — the seeds the interprocedural fixpoint grows from.
+TAINT_SOURCE_PARAMS = frozenset(
+    {
+        ("sim/router.py", "_enqueue", "message"),
+        ("net/node.py", "_on_net_state", "net_state"),
+        ("net/node.py", "_on_join_plan", "payload"),
+        ("net/node.py", "_on_era_transcript", "payload"),
+        ("net/node.py", "_on_key_gen_message", "payload"),
+        ("net/node.py", "_on_consensus_message", "payload"),
+        ("net/node.py", "_discover", "peers_info"),
+        # the codec parses raw frames: its buffer is the attack surface
+        ("utils/codec.py", "_py_decode", "buf"),
+        ("utils/codec.py", "_decode_at", "buf"),
+        ("utils/codec.py", "_read_uvarint", "buf"),
+    }
+)
+
+# Value clamps: a call to one of these with at least one clean argument
+# yields a clean (bounded) value.
+CLAMP_FUNCS = frozenset({"min", "max"})
+
+# --------------------------------------------------------------------------
+# attacker-taint sinks — scoping
+# --------------------------------------------------------------------------
+
+# Unbounded-container-growth findings are scoped to the io planes where
+# raw attacker bytes land; consensus cores receive membership-gated,
+# signature-checked traffic and their queues are epoch-bounded (pinned
+# by the sim soak's flat-RSS assertion rather than by this pass).
+GROWTH_SCOPE = ("net/", "sim/")
+
+# Loop-bound/repetition sinks are scoped to the frame-PARSING planes:
+# there a count comes straight out of attacker bytes (a varint, a list
+# header).  Deeper planes (crypto/, ops/) receive structure-validated
+# objects whose sizes the dkg/threshold layers pin (degree checks, row
+# counts, shard counts) — their loop bounds track validated structure,
+# not raw wire integers.
+LOOP_BOUND_SCOPE = ("net/", "sim/", "utils/")
+
+# --------------------------------------------------------------------------
+# secret-taint
+# --------------------------------------------------------------------------
+
+# An identifier is secret-seeded when, split on underscores, it contains
+# one of these tokens ("our_sk", "sk_share", "secret_key", "seckey"…).
+SECRET_NAME_TOKENS = frozenset({"sk", "secret", "seckey"})
+
+# Explicit identifier substrings that do not tokenise cleanly.
+SECRET_NAMES = frozenset({"chan_key", "channel_key", "key_material"})
+
+# Classes whose instances ARE key material: constructing, receiving or
+# unpacking one taints the value; each must also define a redacting
+# __repr__ (checked by the class-hygiene half of the pass).
+SECRET_CLASSES = frozenset({"SecretKey", "SecretKeyShare", "SecretKeySet"})
+
+# Calls that legitimately consume secrets (sealing / KDF / signing /
+# group-exponentiation primitives): a secret disappearing into one of
+# these is the intended use, not an egress.  Matched on the dotted call
+# name's last component.
+SECRET_SEAL_FUNCS = frozenset(
+    {
+        "_seal",
+        "_seal_batch",
+        "_open",
+        "_keystream_xor",
+        "_kdf",
+        "sha256",
+        "sha",
+        "digest",
+        "new",
+        "compare_digest",
+        "_pair_digest",
+        "mul_sub",
+        "multiply",
+        "fr_random",
+        "pow",
+        # one-way group maps: their output is public-key-grade
+        "hash_to_g2",
+        "interpolate_g_at_zero",
+        "g1_to_bytes",
+        "g2_to_bytes",
+        # curve-point arithmetic: outputs are group elements, blinded by
+        # the discrete log (the same rationale as mul_sub/multiply)
+        "jac_add",
+        "jac_double",
+        "jac_add_core_formula",
+        "jac_double_formula",
+        "add",
+        "eq",
+    }
+)
+
+# Metadata reads that are safe on a secret-tainted base: the SIZE or
+# TYPE of key material is not key material.
+SECRET_SAFE_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "kind", "fault", "valid", "recorded"}
+)
+SECRET_SAFE_CALLS = frozenset({"len", "type", "isinstance", "id", "qsize"})
+
+# Logger variable names: a call on one of these is a logging sink.
+LOG_NAMES = frozenset({"log", "logger", "logging"})
+
+# --------------------------------------------------------------------------
+# retrace-budget
+# --------------------------------------------------------------------------
+
+# Shape-bucket sanitizers: map a dynamic dimension onto a small fixed
+# set of values.  Matched on bare function name.
+SHAPE_BUCKET_FUNCS = frozenset({"_bucket"})
+
+# Upper bound on distinct values one bucketed dimension can take: the
+# {2^k, 1.5*2^k} ladder emits 2 values per power-of-two decade, so 24
+# covers dims up to 2^12 = 4096 (far beyond any validator-set ceiling).
+BUCKET_CAPACITY = 24
+
+# Helpers declared shape-sanitizing end to end: every array/length they
+# return has every dynamic dimension bucketed.  The pass VERIFIES each
+# named function exists and (transitively) calls a registered bucket —
+# a stale or bucket-less entry is itself a finding.
+SANITIZING_FUNCS = {
+    "ops/msm_T.py::_pack_jobs": "pads (jobs, points) to _bucket'd (b, s)",
+    "ops/bls_jax.py::_pad_mul_batch": "pads the scalar-mul batch dim to _bucket",
+}
+
+# Jit entrypoints whose dynamic dims are bounded by fixed process
+# configuration rather than buckets: "module_relpath::fn" -> why the
+# signature set stays finite.  The pass fails on an entry naming a
+# function that no longer exists (stale declaration) and on any jit
+# entrypoint that is neither budgeted in its module's RETRACE_BUDGETS
+# nor declared here.
+CONFIG_BOUNDED_JIT = {
+    "ops/bls_jax.py::jac_scalar_mul": (
+        "bit-ladder lanes: batch dim = instances x nodes of one sim/bench "
+        "config; a process runs a handful of configs, each compiled once"
+    ),
+    "ops/bls_jax.py::_jac_scalar_mul_glv_xla": (
+        "GLV ladder lanes; the hot varying-size caller "
+        "(g1_scalar_mul_batch) pads to _pad_mul_batch buckets, remaining "
+        "callers are fixed-shape bench/msm planes"
+    ),
+    "ops/bls_jax.py::_jac_scalar_mul_windowed_xla": (
+        "windowed ladder lanes; window count bucketed by msm_T, lanes by "
+        "_pack_jobs"
+    ),
+    "ops/bls_jax.py::jac_weighted_sum": (
+        "[B, S]: S = quorum size (t+1, fixed per era), B = instance batch "
+        "of one config"
+    ),
+    "ops/bls_jax.py::jac_weighted_sum_windowed": (
+        "same [B, S] geometry as jac_weighted_sum"
+    ),
+    "ops/bls_g2_jax.py::_g2_scalar_mul_windowed_xla": (
+        "G2 ladder lanes; the varying-size caller (g2_scalar_mul_batch) "
+        "pads to _pad_mul_batch buckets"
+    ),
+    "ops/bls_g2_jax.py::g2_weighted_sum_windowed": (
+        "[B, S]: S = signature quorum (t+1, fixed per era)"
+    ),
+    "ops/fq_T.py::jac_scalar_mul_glv_T": (
+        "T-plane GLV ladder: lanes bucketed by msm_T._pack_jobs; window "
+        "count fixed at 33"
+    ),
+    "ops/fq_T.py::jac_scalar_mul_windowed_T": (
+        "T-plane windowed ladder: lanes and window count bucketed by "
+        "msm_T (_pack_jobs / _bucket)"
+    ),
+    "ops/fq2_T.py::g2_scalar_mul_windowed_T": (
+        "G2 T-plane ladder: lane count fixed by the calling bench/kernel "
+        "shape"
+    ),
+    "ops/pairing_jax.py::_pairing_eq_kernel": (
+        "pairing lanes = shares per poll, bounded by the validator-set "
+        "size of one config"
+    ),
+    "ops/pairing_T.py::pairing_eq_kernel_T": (
+        "T-plane pairing lanes; same geometry as _pairing_eq_kernel"
+    ),
+    "ops/vandermonde_T.py::fold": (
+        "shape keyed by (t+1, #indices) of one DKG era; the enclosing "
+        "builder caches one compile per era geometry"
+    ),
+    "ops/decrypt_T.py::epoch": (
+        "decrypt lanes = (instances, quorum) of one config; builder-cached"
+    ),
+    "ops/circuit_T.py::fn": (
+        "circuit shape fixed by the compiled circuit; builder-cached"
+    ),
+    "ops/rs_jax.py::_apply_pallas": (
+        "shard geometry is static_argnames; payload tile fixed per config"
+    ),
+    "ops/rs_jax.py::_encode_batch_pallas": (
+        "shard geometry is static_argnames; B per config"
+    ),
+    "ops/rs_jax.py::_encode_batch": (
+        "shard geometry is static_argnames; B per config"
+    ),
+    "ops/rs_jax.py::_reconstruct_batch": (
+        "survivor-row pattern folds into dbits data; data_shards static"
+    ),
+    "ops/gf256_jax.py::_bits_matmul": (
+        "GF(2^8) bit-matmul operand shapes fixed per (n, tile) config"
+    ),
+    "ops/gf256_jax.py::_gf_matmul_pallas": (
+        "tile_l is a static_argname; operand shapes per config"
+    ),
+}

@@ -1,0 +1,319 @@
+"""Rule ``retrace-budget``: every jit entrypoint's signature set is
+declared, bucketed, and bounded.
+
+A ``@jax.jit`` function compiles once per distinct input signature.  On
+this codebase a fresh XLA:CPU trace of a ladder costs up to a minute,
+so an entrypoint whose shapes track raw protocol load (poll sizes, part
+counts, scalar widths) retraces unboundedly — the exact failure the
+``_bucket`` tables in ``ops/msm_T.py`` exist to prevent.  Comments
+don't stay true; this pass makes the tables CHECKED DECLARATIONS:
+
+  * every jit-decorated function under ``ops/`` and ``crypto/`` must be
+    covered either by an entry in its module's ``RETRACE_BUDGETS`` dict
+    (bucket-fed entrypoints) or by
+    ``lint/registry.py:CONFIG_BOUNDED_JIT`` (dims fixed by process
+    config, justification mandatory);
+  * a ``RETRACE_BUDGETS`` entry declares the maximum number of distinct
+    bucket-derived variables that may feed the entrypoint's call-site
+    arguments.  The pass statically enumerates each call site's
+    argument provenance on the lattice {static < bucketed < dynamic}:
+    a *dynamic* dim (not a literal, not a module constant, not derived
+    from a registered bucket/sanitizer) is an UNBOUNDED signature set
+    and fails outright; more bucketed variables than declared fails as
+    over-budget (each bucketed dim multiplies the compile cache by up
+    to ``registry.BUCKET_CAPACITY``);
+  * stale declarations (naming functions that no longer exist) and
+    registered sanitizers that no longer call a bucket are findings
+    too — the registry cannot drift from the code it blesses.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Set
+
+from . import Finding, PACKAGE_ROOT, SourceFile
+from . import registry
+from .callgraph import CallGraph, FuncInfo, build as build_graph
+from .dataflow import FunctionAnalysis, Policy
+
+RULE = "retrace-budget"
+
+ANCHOR = "__init__.py"  # package pass, anchored on the root
+
+SCOPE = ("ops/", "crypto/")
+
+STATIC, BUCKETED, DYNAMIC = 0, 1, 2
+
+# array constructors whose result shape is fully determined by their
+# ARGUMENTS (the base array's provenance is irrelevant)
+_SHAPE_FROM_ARGS = frozenset(
+    {"reshape", "zeros", "empty", "ones", "full", "broadcast_to", "arange"}
+)
+
+
+def applies(relpath: str) -> bool:
+    return relpath == ANCHOR
+
+
+def _is_sanitizing(qual_or_name: str, relpath: str) -> bool:
+    """EXACT module-qualified match only: a same-named helper in another
+    module must not inherit a registration it never earned (the drift
+    this pass exists to catch)."""
+    key = f"{relpath}::{qual_or_name.split('.')[-1]}"
+    return key in registry.SANITIZING_FUNCS
+
+
+class RetracePolicy(Policy):
+    TOP = DYNAMIC
+
+    def param_state(self, fi: FuncInfo, param: str) -> int:
+        if param in ("self", "cls"):
+            return STATIC
+        return DYNAMIC
+
+    def unknown_name_state(self, name: str) -> int:
+        return STATIC  # module constants (N_LIMBS, BETA_COL, ...)
+
+    def call_state(self, walker, node, dotted, site, base_state, arg_states):
+        dn = dotted or ""
+        bare = dn.split(".")[-1]
+        if bare in registry.SHAPE_BUCKET_FUNCS:
+            return BUCKETED
+        if site is not None and site.targets:
+            for t in site.targets:
+                fi = walker.graph.functions.get(t) if walker.graph else None
+                if fi is not None and _is_sanitizing(fi.name, fi.relpath):
+                    return BUCKETED
+        if _is_sanitizing(bare, walker.fi.relpath):
+            return BUCKETED
+        if bare in _SHAPE_FROM_ARGS:
+            return max(arg_states, default=STATIC)
+        return max([base_state] + arg_states, default=STATIC)
+
+
+# -- declaration extraction --------------------------------------------------
+
+
+def module_budgets(sf_tree: ast.AST) -> Dict[str, int]:
+    """``RETRACE_BUDGETS = {"fn": n, ...}`` extracted statically."""
+    for node in ast.walk(sf_tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "RETRACE_BUDGETS"
+            for t in node.targets
+        ):
+            continue
+        out: Dict[str, int] = {}
+        if isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)
+                ):
+                    out[k.value] = v.value
+        return out
+    return {}
+
+
+# -- the rule ----------------------------------------------------------------
+
+
+def check_root(root: Path, shown_prefix: str) -> List[Finding]:
+    graph = build_graph(root)
+    findings: List[Finding] = []
+
+    def emit(relpath: str, line, message: str) -> None:
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=f"{shown_prefix}/{relpath}",
+                line=getattr(line, "lineno", line) or 1,
+                message=message,
+            )
+        )
+
+    entrypoints = [
+        fi
+        for fi in graph.jit_entrypoints()
+        if fi.relpath.startswith(SCOPE)
+    ]
+    by_key = {f"{fi.relpath}::{fi.name}": fi for fi in entrypoints}
+
+    budgets: Dict[str, Dict[str, int]] = {}
+    for relpath in sorted({fi.relpath for fi in entrypoints}):
+        sf = graph.sources.get(relpath)
+        budgets[relpath] = module_budgets(sf.tree) if sf else {}
+
+    # 1. coverage: every entrypoint declared somewhere
+    for fi in sorted(entrypoints, key=lambda f: (f.relpath, f.lineno)):
+        key = f"{fi.relpath}::{fi.name}"
+        in_budget = fi.name in budgets.get(fi.relpath, {})
+        in_config = key in registry.CONFIG_BOUNDED_JIT
+        if not in_budget and not in_config:
+            emit(
+                fi.relpath,
+                fi.node,
+                f"jit entrypoint {fi.name!r} has no retrace declaration — "
+                "add it to this module's RETRACE_BUDGETS (bucket-fed) or "
+                "to lint/registry.py:CONFIG_BOUNDED_JIT with a "
+                "justification",
+            )
+
+    # 2. stale declarations
+    for relpath, table in budgets.items():
+        mod_fns = {
+            fi.name for fi in graph.functions.values()
+            if fi.relpath == relpath
+        }
+        for name in sorted(table):
+            if name not in mod_fns:
+                emit(
+                    relpath,
+                    1,
+                    f"RETRACE_BUDGETS entry {name!r} names a function "
+                    "that no longer exists in this module",
+                )
+    # registry staleness is only meaningful against the real package
+    # root (fixture roots legitimately lack the registered modules)
+    check_registry = root.resolve() == PACKAGE_ROOT.resolve()
+    for key in sorted(registry.CONFIG_BOUNDED_JIT):
+        relpath, name = key.split("::", 1)
+        if not (root / relpath).exists():
+            if check_registry:
+                emit(
+                    "lint/registry.py",
+                    1,
+                    f"CONFIG_BOUNDED_JIT entry {key!r} names a missing "
+                    "module",
+                )
+            continue
+        exists = any(
+            fi.relpath == relpath and fi.name == name
+            for fi in graph.functions.values()
+        )
+        if not exists:
+            emit(
+                "lint/registry.py",
+                1,
+                f"CONFIG_BOUNDED_JIT entry {key!r} names a function that "
+                "no longer exists — prune the stale declaration",
+            )
+
+    # 3. registered sanitizers must really bucket
+    for key in sorted(registry.SANITIZING_FUNCS):
+        relpath, name = key.split("::", 1)
+        fi = next(
+            (
+                f
+                for f in graph.functions.values()
+                if f.relpath == relpath and f.name == name
+            ),
+            None,
+        )
+        if fi is None:
+            if (root / relpath).exists() or relpath in graph.sources:
+                emit(
+                    "lint/registry.py",
+                    1,
+                    f"SANITIZING_FUNCS entry {key!r} names a function that "
+                    "no longer exists",
+                )
+            continue
+        if not _calls_bucket(graph, fi):
+            emit(
+                fi.relpath,
+                fi.node,
+                f"{name!r} is registered shape-sanitizing but never calls "
+                "a registered bucket (registry.SHAPE_BUCKET_FUNCS) — the "
+                "declaration has drifted from the code",
+            )
+
+    # 4. budgeted entrypoints: enumerate call-site provenance
+    policy = RetracePolicy()
+    analyses: Dict[str, FunctionAnalysis] = {}
+    for relpath, table in sorted(budgets.items()):
+        for name, budget in sorted(table.items()):
+            fi = by_key.get(f"{relpath}::{name}")
+            if fi is None:
+                continue
+            sites = graph.callers_of.get(fi.qualname, [])
+            for site in sites:
+                caller = graph.functions.get(site.caller)
+                if caller is None:
+                    continue
+                fa = analyses.get(caller.qualname)
+                if fa is None:
+                    fa = FunctionAnalysis(graph, caller, policy)
+                    analyses[caller.qualname] = fa
+                dyn, bucket_vars = _site_provenance(fa, site.node)
+                if dyn:
+                    emit(
+                        caller.relpath,
+                        site.node,
+                        f"jit entrypoint {name!r} sees an UNBOUNDED "
+                        f"signature set from {caller.name!r}: argument "
+                        f"derives from dynamic value(s) {sorted(dyn)} — "
+                        "route the dimension through a registered shape "
+                        "bucket",
+                    )
+                elif len(bucket_vars) > budget:
+                    cap = registry.BUCKET_CAPACITY
+                    emit(
+                        caller.relpath,
+                        site.node,
+                        f"jit entrypoint {name!r} over budget: "
+                        f"{len(bucket_vars)} bucketed dims "
+                        f"{sorted(bucket_vars)} vs declared {budget} "
+                        f"(compile cache bound {cap}^dims) — bump "
+                        "RETRACE_BUDGETS deliberately or fold dims",
+                    )
+    findings.sort(key=lambda f: (f.path, f.line))
+    return findings
+
+
+def _site_provenance(fa: FunctionAnalysis, call: ast.Call):
+    """(dynamic var names, bucketed var names) feeding a call's args."""
+    env = fa.env  # post-walk environment (converged bindings)
+    dyn: Set[str] = set()
+    bucketed: Set[str] = set()
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        state = fa.eval(arg, env)
+        names = {
+            n.id
+            for n in ast.walk(arg)
+            if isinstance(n, ast.Name)
+        }
+        if state == DYNAMIC:
+            bad = {
+                n for n in names
+                if fa.eval(ast.Name(id=n, ctx=ast.Load()), env) == DYNAMIC
+            } or {ast.dump(arg)[:40]}
+            dyn |= bad
+        elif state == BUCKETED:
+            bucketed |= {
+                n for n in names
+                if fa.eval(ast.Name(id=n, ctx=ast.Load()), env) == BUCKETED
+            } or {f"<expr@{call.lineno}>"}
+    return dyn, bucketed
+
+
+def _calls_bucket(graph: CallGraph, fi: FuncInfo, depth: int = 2) -> bool:
+    for site in graph.calls_by_caller.get(fi.qualname, []):
+        bare = (site.dotted or "").split(".")[-1]
+        if bare in registry.SHAPE_BUCKET_FUNCS:
+            return True
+        if depth > 0:
+            for t in site.targets:
+                tfi = graph.functions.get(t)
+                if tfi is not None and _calls_bucket(graph, tfi, depth - 1):
+                    return True
+    return False
+
+
+def check(sf: SourceFile) -> List[Finding]:
+    root = sf.path.parent if sf.relpath == ANCHOR else PACKAGE_ROOT
+    return check_root(root, PACKAGE_ROOT.name)

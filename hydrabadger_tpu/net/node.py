@@ -72,6 +72,24 @@ KEEPALIVE_TICK_S = 20.0
 # so the relay path bounds them; larger payloads belong in a signed
 # validator contribution
 MAX_TXN_BYTES = 1024 * 1024
+# The single-consumer handler queue is attacker-paced (every socket
+# frame lands here): bounded so a flooding peer hits TCP backpressure
+# (the read loops await put) instead of growing host memory.
+INTERNAL_QUEUE_CAP = 65536
+# net_state gossip is unsigned and attacker-writable: clamp the dial
+# fan-out one frame can trigger (honest rosters re-gossip, so a
+# truncated roster still converges over later frames)
+DISCOVERY_FANOUT_CAP = 256
+# replay transcript bound (our part + <= n acks per live instance);
+# shares the inbox ceiling so both sides of the replay net agree
+KEYGEN_OUTBOX_CAP = KEYGEN_INBOX_CAP
+# any established peer can open user-scoped DKG instances by sending a
+# fresh instance id; each one costs a Part broadcast (n^2 traffic), so
+# the live-instance count is capped
+MAX_USER_KEYGENS = 64
+# consensus frames arriving before the DHB exists; senders replay via
+# their epoch-replay loop, so dropping beyond the cap only delays
+IOM_QUEUE_CAP = 8192
 
 
 @dataclass
@@ -123,8 +141,11 @@ class KeyGenMachine:
         self.n = 0
         self.event_queue: asyncio.Queue = asyncio.Queue()
         # acks that raced ahead of their part (the reference queues these
-        # until the part count is complete, key_gen.rs:96-114)
-        self.pending_acks: List[tuple] = []
+        # until the part count is complete, key_gen.rs:96-114), keyed by
+        # (sender, proposer_idx) — replays dedup to one slot, and with
+        # proposer indices range-checked the key space is exactly n^2,
+        # so the queue is bounded by construction
+        self.pending_acks: Dict[tuple, Ack] = {}
 
     def start(self, our_uid, our_sk, pub_keys: Dict, rng) -> Part:
         self.n = len(pub_keys)
@@ -157,9 +178,20 @@ class KeyGenMachine:
 
     def handle_ack(self, sender, ack: Ack):
         if ack.proposer_idx not in self.kg.parts:
-            self.pending_acks.append((sender, ack))
             from ..crypto.dkg import AckOutcome
 
+            # a valid proposer index is a member slot: junk for
+            # never-possible parts is rejected outright instead of
+            # cycling through the pending queue forever
+            n = len(self.kg.node_ids)
+            if not 0 <= int(ack.proposer_idx) < n:
+                return AckOutcome(False, fault="proposer index out of range")
+            if len(self.pending_acks) >= n * n:
+                # unreachable for honest + Byzantine senders combined
+                # (<= n senders x n proposer slots after dedup); a loud
+                # guard in case the invariant ever breaks
+                return AckOutcome(False, fault="pending-ack overflow")
+            self.pending_acks.setdefault((sender, ack.proposer_idx), ack)
             return AckOutcome(True)  # queued, not judged yet
         return self.kg.handle_ack(sender, ack)
 
@@ -172,8 +204,8 @@ class KeyGenMachine:
         return sum(len(st.acks) for st in self.kg.parts.values())
 
     def _drain_pending_acks(self) -> None:
-        pending, self.pending_acks = self.pending_acks, []
-        for sender, ack in pending:
+        pending, self.pending_acks = self.pending_acks, {}
+        for (sender, _proposer), ack in pending.items():
             self.handle_ack(sender, ack)
 
     def is_complete(self) -> bool:
@@ -234,7 +266,10 @@ class Hydrabadger:
         self.batches: List[DhbBatch] = []
         self.epoch_listeners: List[asyncio.Queue] = []
         self.current_epoch = self.cfg.start_epoch
-        self._internal: asyncio.Queue = asyncio.Queue()
+        self._internal: asyncio.Queue = asyncio.Queue(
+            maxsize=INTERNAL_QUEUE_CAP
+        )
+        self._overflow_tasks: set = set()  # awaited puts on a full queue
         self._dialing: set = set()  # OutAddrs with a connect in flight
         self._tasks: List[asyncio.Task] = []
         self._share_recovery_task: Optional[asyncio.Task] = None
@@ -282,13 +317,13 @@ class Hydrabadger:
         """Queue a contribution; False when not (yet) a validator."""
         if not self.is_validator():
             return False
-        self._internal.put_nowait(("api_propose", bytes(contribution)))
+        self._internal_put(("api_propose", bytes(contribution)))
         return True
 
     def vote_for(self, change: tuple) -> bool:
         if self.dhb is None:
             return False
-        self._internal.put_nowait(("api_vote", tuple(change)))
+        self._internal_put(("api_vote", tuple(change)))
         return True
 
     def submit_transaction(self, txn: bytes) -> bool:
@@ -310,7 +345,7 @@ class Hydrabadger:
         if len(txn) > MAX_TXN_BYTES:
             return False
         if self.is_validator():
-            self._internal.put_nowait(("api_propose", txn))
+            self._internal_put(("api_propose", txn))
             return True
         msg = wire.transaction(txn)
         if self.dhb is not None:
@@ -369,7 +404,7 @@ class Hydrabadger:
         (('complete', pk_set, share) | ('failed', reason)) arrive on the
         returned queue.  (reference: hydrabadger.rs:312-320)"""
         machine = KeyGenMachine(("user", self.uid.bytes))
-        self._internal.put_nowait(("api_user_keygen", machine))
+        self._internal_put(("api_user_keygen", machine))
         return machine.event_queue
 
     async def run_node(
@@ -422,7 +457,7 @@ class Hydrabadger:
             if first.kind != "hello_request_change_add":
                 log.warning("first frame from %s was %s", out_addr, first.kind)
                 return
-            self._internal.put_nowait(("incoming_hello", peer, first))
+            self._internal_put(("incoming_hello", peer, first))
             await self._read_loop(peer, stream)
         except (ConnectionError, asyncio.IncompleteReadError, OSError, ValueError):
             pass
@@ -481,11 +516,31 @@ class Hydrabadger:
     async def _read_loop(self, peer: Peer, stream: WireStream) -> None:
         while True:
             msg, body, sig = await stream.recv()
-            self._internal.put_nowait(("peer_msg", peer, msg, body, sig))
+            # awaited put: when the handler queue is full the reader
+            # stops reading, so a flooding peer stalls on its own TCP
+            # window instead of growing our memory
+            await self._internal.put(("peer_msg", peer, msg, body, sig))
 
     def _drop_peer(self, peer: Peer) -> None:
         if peer.out_addr in self.peers.by_addr:
-            self._internal.put_nowait(("peer_disconnect", peer))
+            self._internal_put(("peer_disconnect", peer))
+
+    def _internal_put(self, item: tuple) -> None:
+        """Enqueue a control-plane event onto the (bounded) handler
+        queue.  On overflow — a node at its flood ceiling — fall back to
+        an awaited put in a tracked task so disconnects and API calls
+        are delayed, never silently dropped."""
+        try:
+            self._internal.put_nowait(item)
+        except asyncio.QueueFull:
+            if len(self._overflow_tasks) >= 1024:
+                # a node this far past its flood ceiling is not making
+                # progress; dropping (loudly) beats unbounded tasks
+                log.warning("handler overflow backlog full; dropping an event")
+                return
+            t = asyncio.create_task(self._internal.put(item))
+            self._overflow_tasks.add(t)
+            t.add_done_callback(self._overflow_tasks.discard)
 
     # -- the single-consumer handler (handler.rs:621-783) -------------------
 
@@ -749,7 +804,7 @@ class Hydrabadger:
                 and len(msg.payload) <= MAX_TXN_BYTES
                 and self.is_validator()
             ):
-                self._internal.put_nowait(("api_propose", bytes(msg.payload)))
+                self._internal_put(("api_propose", bytes(msg.payload)))
         elif kind == "goodbye":
             peer.close()
         elif kind == "ping":
@@ -786,7 +841,21 @@ class Hydrabadger:
             self._discover(peers_info)
 
     def _discover(self, peers_info) -> None:
-        """Dial newly-learned peers (handler.rs:377-393)."""
+        """Dial newly-learned peers (handler.rs:377-393).
+
+        net_state gossip is unsigned (attacker-writable), so the dial
+        fan-out one frame can trigger is clamped and completed dial
+        tasks are pruned before new ones are tracked — a forged
+        million-entry roster must cost neither a million sockets nor a
+        million task objects.  Honest rosters re-gossip every retry
+        tick, so truncation still converges."""
+        if len(peers_info) > DISCOVERY_FANOUT_CAP:
+            log.warning(
+                "truncating oversized peers_info gossip (%d entries)",
+                len(peers_info),
+            )
+            peers_info = peers_info[:DISCOVERY_FANOUT_CAP]
+        self._tasks = [t for t in self._tasks if not t.done()]
         for uid_b, host, port, pk_b in peers_info:
             uid = Uid(bytes(uid_b))
             if uid == self.uid or self.peers.get_by_uid(uid) is not None:
@@ -902,7 +971,13 @@ class Hydrabadger:
 
     def _broadcast_keygen(self, instance_id: tuple, payload: tuple) -> None:
         msg = wire.key_gen_message(self.uid, instance_id, payload)
-        self.keygen_outbox.append(msg)
+        # the replay transcript is bounded: honest traffic is one part +
+        # <= n acks per live instance, far under the cap — only a flood
+        # of attacker-spawned instances could reach it
+        if len(self.keygen_outbox) < KEYGEN_OUTBOX_CAP:
+            self.keygen_outbox.append(msg)
+        else:
+            log.warning("keygen outbox full; frame not recorded for replay")
         self.peers.wire_to_all(msg)
 
     def _on_key_gen_message(self, src: bytes, instance_id: tuple, payload) -> None:
@@ -918,6 +993,7 @@ class Hydrabadger:
                 if entry not in self._keygen_inbox_seen:
                     if len(self.keygen_inbox) < KEYGEN_INBOX_CAP:
                         self.keygen_inbox.append(entry)
+                        # hblint: disable=attacker-taint -- 1:1 mirror of keygen_inbox; growth is bounded by the same KEYGEN_INBOX_CAP guard above
                         self._keygen_inbox_seen.add(entry)
                     else:
                         log.warning("keygen inbox overflow; dropping frame")
@@ -940,6 +1016,7 @@ class Hydrabadger:
                 # parts of this poll verify as one batched MSM; an ack
                 # racing its part within the same poll already parks in
                 # KeyGenMachine.pending_acks and drains at flush
+                # hblint: disable=attacker-taint -- poll-scoped buffer: reset to [] by the handler loop every poll, so growth is bounded by the 50-message poll budget
                 self._kg_poll.append((machine, tuple(instance_id), src, part))
                 return
             outcome = machine.handle_part(src, part)
@@ -1050,7 +1127,18 @@ class Hydrabadger:
         Used by the initiator (`new_key_gen_instance`) and by every other
         node when the instance's first message arrives (handler.rs:523-538)."""
         instance_id = machine.instance_id
-        self.user_key_gens[bytes(instance_id[1])] = machine
+        key = bytes(instance_id[1])
+        # any established peer can mint fresh instance ids, and every
+        # instance costs a Part broadcast: cap the live set
+        if key not in self.user_key_gens and (
+            len(self.user_key_gens) >= MAX_USER_KEYGENS
+        ):
+            log.warning("user keygen cap reached; ignoring new instance")
+            machine.event_queue.put_nowait(
+                ("failed", "too many live keygen instances")
+            )
+            return
+        self.user_key_gens[key] = machine
         part = machine.start(
             self.uid.bytes, self.secret_key, self._keygen_pub_keys(), self.rng
         )
@@ -1088,7 +1176,13 @@ class Hydrabadger:
 
     def _on_consensus_message(self, src: bytes, payload) -> None:
         if self.dhb is None:
-            self.iom_queue.append((src, payload))
+            # bounded pre-consensus buffer: a flood before the DKG
+            # completes must not grow host memory; dropped frames heal
+            # via the senders' epoch-replay loops
+            if len(self.iom_queue) < IOM_QUEUE_CAP:
+                self.iom_queue.append((src, payload))
+            else:
+                log.warning("pre-consensus queue full; dropping frame")
             return
         step = self.dhb.handle_message(src, payload)
         self._dispatch_step(step)
@@ -1197,9 +1291,11 @@ class Hydrabadger:
         # epoch e already recorded our first epoch-e+1 frames — tagged e
         # at dispatch time, so the `< batch.epoch` sweep keeps them for
         # stall replay.)
+        # hblint: disable=attacker-taint -- epoch-paced (one entry per COMMITTED epoch, not per frame); retention of the batch history is the application's call
         self.batches.append(batch)
         self._flush_user_contributions()  # the next epoch just opened
         self.current_epoch = batch.epoch + 1
+        # hblint: disable=attacker-taint -- epoch-paced public-API queue; the application consumer owns drain pacing (register via batch_queue)
         self.batch_queue.put_nowait(batch)
         if batch.join_plan is not None:
             self.peers.wire_to_all(
@@ -1479,6 +1575,6 @@ class Hydrabadger:
                 )
                 from ..utils import codec
 
-                self._internal.put_nowait(
+                self._internal_put(
                     ("api_propose", codec.encode(tuple(txns)))
                 )

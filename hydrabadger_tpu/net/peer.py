@@ -19,6 +19,14 @@ from .wire import WireMessage, WireStream
 
 log = logging.getLogger("hydrabadger_tpu.net.peer")
 
+# Per-peer outbound backlog ceiling.  The pump drains the queue onto the
+# socket; a peer that stops reading (slow-loris) freezes the pump on TCP
+# backpressure while broadcasts keep queueing — without a cap every
+# attacker-triggered reply (pongs, transcripts, gossip) pins memory
+# forever.  Beyond the cap the link is treated as dead: the node's
+# disconnect path salvages undelivered frames into its wire-retry queue.
+SEND_QUEUE_CAP = 8192
+
 
 @dataclass
 class Peer:
@@ -63,10 +71,37 @@ class Peer:
             self.pump_task = asyncio.create_task(self._pump())
 
     def send(self, msg: WireMessage) -> None:
+        if self.send_queue.qsize() >= SEND_QUEUE_CAP:
+            # a peer not draining thousands of frames is dead or
+            # hostile; dropping the CONNECTION (not silently the frame)
+            # routes recovery through the salvage/wire-retry path.  The
+            # triggering frame is still enqueued first so drain_unsent
+            # salvages it along with the rest of the backlog.
+            log.warning(
+                "send queue overflow to %s; dropping connection",
+                self.out_addr,
+            )
+            self.send_queue.put_nowait(msg)
+            self.abort()
+            return
         self.send_queue.put_nowait(msg)
 
     def close(self) -> None:
-        self.send_queue.put_nowait(None)
+        # graceful: the pump drains queued frames, then exits on the
+        # sentinel.  Idempotent — repeated closes (overflow +
+        # disconnect races) must not queue a sentinel per call.
+        if self.state != "closing":
+            self.state = "closing"
+            self.send_queue.put_nowait(None)
+
+    def abort(self) -> None:
+        """Hard close: tear the transport down NOW.  A pump wedged in
+        ``wire.send`` behind TCP backpressure would never reach a
+        sentinel queued behind thousands of frames; closing the socket
+        errors both the pump and the node's reader task, which routes
+        recovery through ``_drop_peer`` -> ``drain_unsent`` salvage."""
+        self.close()
+        self.wire.close()
 
     def drain_unsent(self) -> List[WireMessage]:
         """Frames queued but not yet pumped onto the socket — salvaged by

@@ -300,10 +300,17 @@ def _fq2_batch_inverse(els: Sequence) -> list:
 
 def g2_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
     """Batched sk * H(m) over G2: signature-share generation for a whole
-    batch of (node, epoch) coin rounds at once."""
+    batch of (node, epoch) coin rounds at once.  Lane count bucketed
+    with identity padding (bls_jax._pad_mul_batch) so coin polls of
+    varying size share compiled ladder shapes."""
+    from .bls_jax import _pad_mul_batch
+
+    points, scalars, n = _pad_mul_batch(
+        points, scalars, bls.infinity(bls.FQ2)
+    )
     pts = jnp.asarray(g2_points_to_limbs(points))
     wins = jnp.asarray(scalars_to_windows([s % bls.R for s in scalars]))
-    return limbs_to_g2_points(g2_scalar_mul_windowed(pts, wins))
+    return limbs_to_g2_points(g2_scalar_mul_windowed(pts, wins))[:n]
 
 
 def g2_weighted_sum_batch(

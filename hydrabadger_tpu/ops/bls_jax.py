@@ -449,7 +449,10 @@ def scalars_to_bits(scalars: Sequence[int], n_bits: int = 255) -> np.ndarray:
     benches don't pay a Python bit loop."""
     for s in scalars:
         if not 0 <= int(s) < (1 << n_bits):
-            raise ValueError(f"scalar out of range [0, 2^{n_bits}): {s}")
+            # NEVER interpolate the scalar: sign/decrypt shares route
+            # raw secret-key scalars through here, and exception text
+            # ends up in logs and crash reports (lint: secret-taint)
+            raise ValueError(f"scalar out of range [0, 2^{n_bits})")
     n_bytes = (n_bits + 7) // 8
     raw = np.frombuffer(
         b"".join(int(s).to_bytes(n_bytes, "big") for s in scalars), dtype=np.uint8
@@ -780,15 +783,49 @@ def limbs_to_points(arr) -> list:
 # ---------------------------------------------------------------------------
 
 
+def _bucket(n: int, floor: int = 1) -> int:
+    """Round a batch dimension up to the next {2^k, 1.5*2^k} bucket so
+    varying batch sizes reuse a handful of compiled shapes (a fresh
+    XLA:CPU trace of a ladder costs up to a minute; the padding itself
+    costs <= 33%).  The shape-bucket sanitizer the retrace-budget lint
+    pass recognises (lint/registry.py:SHAPE_BUCKET_FUNCS)."""
+    n = max(n, floor)
+    p = 1
+    while p < n:
+        if p + p // 2 >= n > p:
+            return p + p // 2
+        p *= 2
+    return p
+
+
+def _pad_mul_batch(points: Sequence, scalars: Sequence[int], inf):
+    """Pad a scalar-mul batch to a bucketed lane count with identity
+    lanes (infinity point, zero scalar — the ladder maps both to the
+    identity, so other lanes are untouched).  Without this every
+    distinct poll/batch size compiled a fresh ladder: the wire-verify
+    plane hands 2..50-frame polls to `_g1_scalar_muls` and each new
+    size was a full retrace.  Returns (points, scalars, real_count);
+    callers slice the result back to real_count.  Registered
+    shape-sanitizing in lint/registry.py:SANITIZING_FUNCS."""
+    n = len(points)
+    b = _bucket(n)
+    if b != n:
+        points = list(points) + [inf] * (b - n)
+        scalars = list(scalars) + [0] * (b - n)
+    return points, scalars, n
+
+
 def g1_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
     """Batched U*sk over G1: len(points) == len(scalars) CPU points in,
     CPU points out.  This is decrypt-share generation for a whole batch
-    of (instance, node) pairs at once."""
+    of (instance, node) pairs at once.  The lane count is bucketed
+    (identity padding) so the compiled-ladder cache stays small."""
+    points, scalars, n = _pad_mul_batch(points, scalars, bls.infinity(FQ))
     pts = jnp.asarray(points_to_limbs(points))
     w1, w2 = scalars_to_glv_windows(scalars)
     return limbs_to_points(
         jac_scalar_mul_glv(pts, jnp.asarray(w1), jnp.asarray(w2))
-    )
+    )[:n]
 
 
 def g1_weighted_sum_batch(

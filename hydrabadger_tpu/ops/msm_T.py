@@ -66,6 +66,7 @@ from . import fq_T
 from .bls_jax import (
     BETA_COL,
     N_LIMBS,
+    _bucket,
     _jac_scalar_mul_glv_xla,
     _jac_scalar_mul_windowed_xla,
     _reduce_tree,
@@ -74,6 +75,20 @@ from .bls_jax import (
     scalars_to_glv_windows,
     scalars_to_windows,
 )
+
+# Checked declarations (lint/retrace_budget): the maximum number of
+# distinct bucket-derived variables that may feed each jit entrypoint's
+# call-site arguments.  Every dynamic dimension below routes through
+# _bucket via _pack_jobs (b, s) or directly (n_win); each bucketed dim
+# multiplies the compile cache by at most registry.BUCKET_CAPACITY.
+# Growing the geometry (a new dynamic dim) fails the lint pass until
+# this table is bumped deliberately.
+RETRACE_BUDGETS = {
+    "_msm_windowed_T": 5,  # limbs(b, s), wins(b, s, n_win)
+    "_msm_glv_T": 5,  # limbs(b, s), w1/w2(b, s; 33 windows static)
+    "_msm_windowed_xla": 5,
+    "_msm_glv_xla": 5,
+}
 
 # RLC scalars are 64-bit; anything this wide or narrower skips the GLV
 # split and runs ⌈bits/4⌉ plain windows (fewer total point ops than the
@@ -158,18 +173,9 @@ def _msm_glv_xla(pts: jax.Array, w1: jax.Array, w2: jax.Array) -> jax.Array:
     return _reduce_tree(_jac_scalar_mul_glv_xla(pts, w1, w2))
 
 
-def _bucket(n: int, floor: int = 1) -> int:
-    """Round a batch dimension up to the next {2^k, 1.5·2^k} bucket so
-    varying poll sizes reuse a handful of compiled shapes (a fresh
-    XLA:CPU trace of the ladder costs ~a minute; padding a 44-point DKG
-    job to 48 lanes costs 9%)."""
-    n = max(n, floor)
-    p = 1
-    while p < n:
-        if p + p // 2 >= n > p:
-            return p + p // 2
-        p *= 2
-    return p
+# (_bucket moved to bls_jax so the scalar-mul batch entries share the
+# same {2^k, 1.5*2^k} ladder; padding a 44-point DKG job to 48 lanes
+# still costs 9%.)
 
 
 def _pack_jobs(

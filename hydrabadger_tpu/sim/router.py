@@ -63,7 +63,18 @@ class Router:
                 if tm.target.includes(recipient):
                     self._enqueue(sender, recipient, tm.message)
 
+    # Queue sanity ceiling: run() bounds DELIVERIES (max_messages), but
+    # the queue itself can outgrow that between deliveries — a broken
+    # core or an amplifying adversary schedule enqueueing faster than
+    # deliver_one drains.  Fail loudly instead of filling host memory.
+    MAX_QUEUE = 4_000_000
+
     def _enqueue(self, sender, recipient, message) -> None:
+        if len(self.queue) >= self.MAX_QUEUE:
+            raise RuntimeError(
+                "router queue exceeded MAX_QUEUE — livelocked cores or "
+                "an amplifying adversary schedule"
+            )
         if self.adversary is not None:
             replacement = self.adversary(sender, recipient, message)
             if replacement is not None:

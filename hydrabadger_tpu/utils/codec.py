@@ -132,6 +132,11 @@ def _decode_at(buf: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
         return buf[pos : pos + n].decode("utf-8"), pos + n
     if tag == ord("L"):
         n, pos = _read_uvarint(buf, pos)
+        # every element costs >= 1 byte, so a count beyond the remaining
+        # buffer is always malformed — reject BEFORE iterating (a forged
+        # 2^60 count must not drive the loop; lint: attacker-taint)
+        if n > len(buf) - pos:
+            raise ValueError("truncated list")
         items = []
         for _ in range(n):
             item, pos = _decode_at(buf, pos, depth + 1)
@@ -139,6 +144,9 @@ def _decode_at(buf: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
         return tuple(items), pos
     if tag == ord("D"):
         n, pos = _read_uvarint(buf, pos)
+        # >= 2 bytes per entry (key + value tags)
+        if 2 * n > len(buf) - pos:
+            raise ValueError("truncated dict")
         out = {}
         for _ in range(n):
             k, pos = _decode_at(buf, pos, depth + 1)

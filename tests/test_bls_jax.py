@@ -66,7 +66,10 @@ def test_jac_double_add_match_reference():
 @pytest.mark.slow
 def test_scalar_mul_batch_including_edges():
     rng = random.Random(17)
-    ks = [0, 1, 2, bls.R - 1, rng.getrandbits(254), rng.getrandbits(64)]
+    # 7 lanes: _pad_mul_batch buckets to 8, so the identity-padding
+    # path is exercised end-to-end against the CPU oracle
+    ks = [0, 1, 2, bls.R - 1, rng.getrandbits(254), rng.getrandbits(64),
+          rng.getrandbits(200)]
     pts = [bls.multiply(bls.G1, rng.getrandbits(100) + 1) for _ in ks]
     out = bj.g1_scalar_mul_batch(pts, ks)
     for got, p, k in zip(out, pts, ks):
@@ -256,3 +259,31 @@ def test_pallas_T_point_ops_bit_exact(monkeypatch):
     via_inf = bj.limbs_to_points(fq_T.to_points_BC(fq_T.jac_add_T(a, inf)))
     for got, p in zip(via_inf, pts):
         assert bls.eq(got, p)
+
+
+def test_pad_mul_batch_identity_lanes():
+    """Batch dims are bucketed with identity lanes so varying poll
+    sizes share compiled ladder shapes (retrace-budget contract); the
+    padding must be invisible to the real lanes."""
+    from hydrabadger_tpu.ops.bls_jax import _bucket, _pad_mul_batch
+
+    inf = bls.infinity(bls.FQ)
+    pts, ks, n = _pad_mul_batch([bls.G1] * 5, [1, 2, 3, 4, 5], inf)
+    assert n == 5
+    assert len(pts) == len(ks) == _bucket(5) == 6
+    assert ks[5:] == [0]
+    assert all(bls.eq(p, inf) for p in pts[5:])
+    # already-bucketed sizes are untouched
+    pts, ks, n = _pad_mul_batch([bls.G1] * 4, [1, 2, 3, 4], inf)
+    assert n == 4 and len(pts) == 4
+
+
+def test_scalar_range_error_redacts_value():
+    """Sign/decrypt paths route raw secret scalars through the window
+    converters; an out-of-range error must describe the failure without
+    the value (lint: secret-taint)."""
+    secret = (1 << 300) + 0x1234567
+    with pytest.raises(ValueError) as ei:
+        bj.scalars_to_bits([secret], n_bits=255)
+    assert str(secret) not in str(ei.value)
+    assert hex(secret)[2:] not in str(ei.value)

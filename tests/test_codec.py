@@ -203,3 +203,30 @@ def test_depth_guard_parity():
         codec._py_encode(nested)
     with pytest.raises(ValueError):
         codec._native.encode(nested)
+
+
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | 0x80 if n else b)
+        if not n:
+            return bytes(out)
+
+
+def test_forged_collection_counts_rejected():
+    """A list/dict header claiming more elements than the remaining
+    bytes could possibly hold is rejected BEFORE the element loop runs
+    (every element costs >= 1 byte) — a forged 2^60 count must never
+    drive iteration or buffering (lint: attacker-taint)."""
+    for raw in (
+        b"L" + _uvarint(1 << 60),
+        b"D" + _uvarint(1 << 60),
+        b"L" + _uvarint(1 << 60) + b"N" * 64,  # some valid elements
+    ):
+        with pytest.raises(ValueError):
+            codec._py_decode(raw)
+    # legitimate collections (count == remaining capacity) still decode
+    assert codec._py_decode(codec._py_encode((None, True))) == (None, True)
+    assert codec._py_decode(codec._py_encode({1: 2})) == {1: 2}

@@ -285,9 +285,13 @@ def test_config_engine_selects_backend():
 
 
 class _QueueOnlyWire:
-    """Stands in for a WireStream; Peer.send only touches send_queue."""
+    """Stands in for a WireStream; Peer.send only touches send_queue
+    (and Peer.abort closes the transport)."""
 
     peer_pk = None
+
+    def close(self):
+        pass
 
 
 def _established_peer(port, uid=None):
@@ -443,3 +447,141 @@ async def test_wire_retry_queue_redelivers_targeted_frames():
         assert not delivered
     finally:
         task.cancel()
+
+
+# -- attacker-taint hardening (PR 3): caps surfaced by the lint pass ---------
+
+
+@pytest.mark.asyncio
+async def test_discover_truncates_forged_roster_and_prunes_tasks():
+    """net_state gossip is unsigned: a forged million-entry roster must
+    cost at most DISCOVERY_FANOUT_CAP dials per frame, and completed
+    dial tasks must not accumulate."""
+    from hydrabadger_tpu.net.node import DISCOVERY_FANOUT_CAP
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 95), fast_config(), seed=3
+    )
+    dialed = []
+
+    async def fake_dial(remote):
+        dialed.append(remote)
+
+    node._connect_outgoing = fake_dial
+    roster = tuple(
+        (Uid().bytes, "203.0.113.9", 1000 + i, b"\x03" * 48)
+        for i in range(DISCOVERY_FANOUT_CAP * 3)
+    )
+    node._discover(roster)
+    assert len(node._tasks) <= DISCOVERY_FANOUT_CAP
+    await asyncio.sleep(0)
+    assert len(dialed) == DISCOVERY_FANOUT_CAP
+    node._discover(())  # prunes the now-done dial tasks
+    assert node._tasks == []
+
+
+def test_pre_consensus_queue_is_bounded():
+    from hydrabadger_tpu.net.node import IOM_QUEUE_CAP
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 96), fast_config(), seed=4
+    )
+    for i in range(IOM_QUEUE_CAP + 50):
+        node._on_consensus_message(b"src", ("hb", i))
+    assert len(node.iom_queue) == IOM_QUEUE_CAP
+
+
+def test_user_keygen_instances_are_capped():
+    from hydrabadger_tpu.net.node import KeyGenMachine, MAX_USER_KEYGENS
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 97), fast_config(), seed=5
+    )
+    for i in range(MAX_USER_KEYGENS):
+        node.user_key_gens[i.to_bytes(4, "big")] = object()
+    machine = KeyGenMachine(("user", b"\xff" * 16))
+    node._activate_user_keygen(machine)
+    assert len(node.user_key_gens) == MAX_USER_KEYGENS
+    assert machine.event_queue.get_nowait() == (
+        "failed",
+        "too many live keygen instances",
+    )
+
+
+def test_pending_acks_bounded_by_construction():
+    """Ahead-of-part acks dedup to one (sender, proposer) slot with the
+    proposer index range-checked, so the pending queue is bounded at
+    n^2 and attacker junk for impossible proposers is rejected outright
+    (it must not cycle through the queue forever)."""
+    from types import SimpleNamespace
+
+    from hydrabadger_tpu.crypto.dkg import Ack
+    from hydrabadger_tpu.net.node import KeyGenMachine
+
+    m = KeyGenMachine(("builtin",))
+    m.kg = SimpleNamespace(parts={}, node_ids=[b"a", b"b", b"c"])
+    # out-of-range proposer: rejected, never queued
+    out = m.handle_ack(b"peer", Ack(999, (b"v",)))
+    assert not out.valid and "out of range" in out.fault
+    assert not m.pending_acks
+    # replays of the same (sender, proposer) dedup to one slot
+    for _ in range(50):
+        assert m.handle_ack(b"peer", Ack(1, (b"v",))).valid
+    assert len(m.pending_acks) == 1
+    # distinct pairs accumulate up to the structural n^2 bound
+    for s in range(10):
+        for p in range(3):
+            m.handle_ack(s.to_bytes(2, "big"), Ack(p, (b"v",)))
+    assert len(m.pending_acks) == 9  # n*n cap hit before all 30 landed
+    out = m.handle_ack(b"one-more", Ack(2, (b"v",)))
+    assert not out.valid and "overflow" in out.fault
+
+
+def test_keygen_outbox_is_capped():
+    from hydrabadger_tpu.net.node import KEYGEN_OUTBOX_CAP
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 98), fast_config(), seed=6
+    )
+    for i in range(KEYGEN_OUTBOX_CAP + 25):
+        node._broadcast_keygen(("builtin",), ("ack", i, ()))
+    assert len(node.keygen_outbox) == KEYGEN_OUTBOX_CAP
+
+
+@pytest.mark.asyncio
+async def test_send_queue_overflow_drops_connection():
+    """A peer that stops draining (slow-loris) gets its connection
+    dropped instead of pinning unbounded outbound frames."""
+    from hydrabadger_tpu.net.peer import SEND_QUEUE_CAP
+
+    peer = _established_peer(4)
+    msg = WireMessage("ping", None)
+    for _ in range(SEND_QUEUE_CAP + 10):
+        peer.send(msg)
+    # the overflow aborted the link: state flips to closing (excluded
+    # from established()), exactly one pump sentinel is queued, and
+    # every frame is retained for drain_unsent salvage — overflow must
+    # cost the CONNECTION, never a consensus frame
+    assert peer.state == "closing"
+    items = _drain(peer)
+    assert items.count(None) == 1
+    assert len([m for m in items if m is not None]) == SEND_QUEUE_CAP + 10
+
+
+@pytest.mark.asyncio
+async def test_internal_put_overflow_defers_not_drops():
+    """Control-plane events on a full handler queue are deferred via an
+    awaited put, never silently dropped."""
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 99), fast_config(), seed=8
+    )
+    node._internal = asyncio.Queue(maxsize=2)
+    node._internal_put(("a",))
+    node._internal_put(("b",))
+    node._internal_put(("c",))  # full: deferred
+    assert len(node._overflow_tasks) == 1
+    assert node._internal.get_nowait() == ("a",)
+    await asyncio.sleep(0)  # the deferred put lands once space frees
+    assert node._internal.qsize() == 2
+    await asyncio.sleep(0)  # done-callback pruned the tracking set
+    assert not node._overflow_tasks

@@ -72,3 +72,17 @@ def test_trusted_setup_shapes():
     assert ni.num_faulty == 2
     assert ni.num_correct == 5
     assert ni.pk_set.threshold == 2
+
+
+def test_router_queue_ceiling():
+    """The router fails loudly when the queue outgrows MAX_QUEUE — an
+    amplifying adversary schedule (or livelocked cores) must not fill
+    host memory silently (lint: attacker-taint)."""
+    from hydrabadger_tpu.sim.router import Router
+
+    r = Router([0, 1], handle=lambda *_a: None)
+    r.MAX_QUEUE = 10
+    with pytest.raises(RuntimeError):
+        for i in range(20):
+            r._enqueue(0, 1, ("m", i))
+    assert len(r.queue) <= 10

@@ -74,3 +74,19 @@ def test_lagrange_interpolation():
     coeffs = th.poly_random(3, rng)
     pts = {x: th.poly_eval(coeffs, x) for x in (2, 5, 9, 11)}
     assert th.poly_interpolate_at_zero(pts) == coeffs[0]
+
+
+def test_secret_reprs_are_redacted():
+    """Key material must never surface through repr/str — a '%s' on any
+    object holding a scalar would print the key into logs (pinned by
+    the secret-taint lint pass's class-hygiene check)."""
+    scalar = 123456789012345678901234567890
+    sk = th.SecretKey(scalar)
+    share = th.SecretKeyShare(scalar)
+    sks = th.SecretKeySet([scalar, scalar + 1])
+    for obj in (sk, share, sks):
+        for rendered in (repr(obj), str(obj)):
+            assert str(scalar) not in rendered
+            assert "redacted" in rendered
+    # the share keeps its own class name visible for diagnostics
+    assert "SecretKeyShare" in repr(share)

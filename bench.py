@@ -297,6 +297,15 @@ def _msm_batch_microrow(batch: int = 128, msm_size: int = 43) -> dict:
         for _ in range(batch)
     ]
 
+    # snapshot the process-wide lane counters so the occupancy below is
+    # THIS row's dispatches only (under --all, earlier configs' DKG
+    # traffic shares the same registry)
+    from hydrabadger_tpu.obs.metrics import default_registry
+
+    reg = default_registry()
+    real0 = reg.counter("msm_real_lanes").value
+    pad0 = reg.counter("msm_pad_lanes").value
+
     host_tier = "native" if native_bls.available() else "python"
     n_host = min(64, batch)
     t0 = time.perf_counter()
@@ -314,6 +323,12 @@ def _msm_batch_microrow(batch: int = 128, msm_size: int = 43) -> dict:
     assert len(got) == len(host_out)
     for g, w in zip(got, host_out):
         assert bls.eq(g, w), "MSM plane diverged from native Pippenger"
+    # obs lane accounting (ops/msm_T notes real vs identity-padding
+    # lanes into the process registry): occupancy < 1.0 is pure bucket-
+    # padding dispatch waste, the gauge this row exists to watch
+    real = reg.counter("msm_real_lanes").value - real0
+    pad = reg.counter("msm_pad_lanes").value - pad0
+    occupancy = round(real / (real + pad), 3) if (real + pad) else 1.0
     return {
         "metric": (
             f"msm_batch_muls_per_sec_{batch}x{msm_size}_"
@@ -322,6 +337,7 @@ def _msm_batch_microrow(batch: int = 128, msm_size: int = 43) -> dict:
         "value": round(accel_mps, 1),
         "unit": "muls/s",
         "vs_baseline": round(accel_mps / host_mps, 2) if host_mps else 0.0,
+        "lane_occupancy": occupancy,
     }
 
 
@@ -367,11 +383,30 @@ def _tcp_testnet_config1(
             await asyncio.sleep(0.2)
         done = min(len(node.batches) for node in nodes)
         dt = time.perf_counter() - t0
+        # obs snapshot of the worst node's bounded queues: the row is a
+        # regression tripwire for backpressure drift, not just a rate
+        peaks = {
+            "internal": max(
+                m.metrics.gauge("internal_queue_depth").high_water
+                for m in nodes
+            ),
+            "peer_send": max(
+                m.metrics.gauge("peer_send_queue_depth").high_water
+                for m in nodes
+            ),
+            "wire_retry": max(
+                m.metrics.gauge("wire_retry_depth").high_water for m in nodes
+            ),
+            "epoch_outbox": max(
+                m.metrics.gauge("epoch_outbox_depth").high_water
+                for m in nodes
+            ),
+        }
         for node in nodes:
             await node.stop()
-        return min(done, epochs) / dt
+        return min(done, epochs) / dt, peaks
 
-    eps = asyncio.run(run())
+    eps, queue_peaks = asyncio.run(run())
     return {
         "metric": (
             "tcp_testnet_epochs_per_sec_4node_full_crypto"
@@ -380,6 +415,7 @@ def _tcp_testnet_config1(
         "value": round(eps, 4),
         "unit": "epochs/s",
         "vs_baseline": 1.0,  # this IS the reference-parity flow
+        "queue_peaks": queue_peaks,
     }
 
 
@@ -402,6 +438,7 @@ def _sim16_config2(epochs: int) -> dict:
         "value": round(m.epochs_per_sec, 3),
         "unit": "epochs/s",
         "vs_baseline": 1.0,  # the host-dispatch baseline itself
+        "queue_peaks": net.queue_peaks(),
     }
 
 

@@ -13,13 +13,12 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import logging
-import os
 import random
 import sys
 from typing import List
 
 from .net.node import Config, Hydrabadger
+from .obs import logging as obs_logging
 from .utils.ids import InAddr, OutAddr
 
 
@@ -32,29 +31,10 @@ def _parse_addr(spec: str):
 
 def setup_logging() -> None:
     """HYDRABADGER_LOG: either a bare level or comma-separated
-    `module=level` filters (the reference's filter recipe, gdb-node:27)."""
-    spec = os.environ.get("HYDRABADGER_LOG", "info")
-    logging.basicConfig(
-        level=logging.WARNING,
-        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
-        stream=sys.stderr,
-    )
-    def resolve(name: str) -> int:
-        # env_logger accepts "trace"/"off"; map them rather than crash
-        aliases = {"TRACE": "DEBUG", "OFF": "CRITICAL", "WARN": "WARNING"}
-        name = aliases.get(name.upper(), name.upper())
-        level = logging.getLevelName(name)
-        return level if isinstance(level, int) else logging.INFO
-
-    for clause in spec.split(","):
-        clause = clause.strip()
-        if not clause:
-            continue
-        if "=" in clause:
-            mod, _, level = clause.partition("=")
-            logging.getLogger(mod).setLevel(resolve(level))
-        else:
-            logging.getLogger().setLevel(resolve(clause))
+    `module=level` filters (the reference's filter recipe, gdb-node:27).
+    The parsing lives in obs.logging now — the net plane's structured
+    logger — with levels and filters preserved."""
+    obs_logging.setup_from_env("info")
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -133,6 +113,21 @@ def make_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--seed", type=int, default=None)
     p.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="record consensus spans (RBC/BA/subset/tdec/epoch) and dump "
+        "on exit: .jsonl -> one event per line, anything else -> "
+        "perfetto-loadable Chrome trace JSON",
+    )
+    p.add_argument(
+        "--metrics",
+        default=None,
+        metavar="PATH",
+        help="dump the node's metrics registry (queue depth/high-water "
+        "gauges, per-kind wire counters, epoch histograms) as JSON on exit",
+    )
+    p.add_argument(
         "--mine",
         action="store_true",
         help="run the toy PoW blockchain demo and exit (peer_node.rs:81-92)",
@@ -178,8 +173,16 @@ def main(argv=None) -> int:
         cfg.verify_shares = False
         cfg.wire_sign = False
 
+    recorder = None
+    if args.trace:
+        from .obs.recorder import Recorder
+
+        recorder = Recorder()
+        # warnings interleave with the spans they explain
+        obs_logging.attach_recorder(recorder)
+
     host, port = args.bind_address
-    node = Hydrabadger(InAddr(host, port), cfg, seed=args.seed)
+    node = Hydrabadger(InAddr(host, port), cfg, seed=args.seed, recorder=recorder)
     remotes = [OutAddr(h, p) for h, p in args.remote_address]
 
     async def run():
@@ -206,6 +209,30 @@ def main(argv=None) -> int:
         asyncio.run(run())
     except KeyboardInterrupt:
         pass
+    finally:
+        if args.trace and recorder is not None:
+            from .obs import export as obs_export
+
+            if args.trace.endswith(".jsonl"):
+                n = obs_export.write_jsonl(recorder.events, args.trace)
+            else:
+                n = obs_export.write_chrome_trace(recorder.events, args.trace)
+            print(f"trace: {n} events -> {args.trace}", file=sys.stderr)
+        if args.metrics:
+            import json
+
+            from .obs.metrics import default_registry
+
+            with open(args.metrics, "w") as fh:
+                json.dump(
+                    {
+                        "node": node.metrics.snapshot(),
+                        "process": default_registry().snapshot(),
+                    },
+                    fh,
+                    indent=1,
+                )
+            print(f"metrics -> {args.metrics}", file=sys.stderr)
     return 0
 
 

@@ -211,6 +211,7 @@ class NodeCheckpoint:
         verify_shares: bool = True,
         rng=None,
         engine=None,
+        recorder=None,
     ) -> DynamicHoneyBadger:
         """Rebuild the consensus core at the saved era/epoch.
 
@@ -238,6 +239,7 @@ class NodeCheckpoint:
             verify_shares=verify_shares,
             rng=rng,
             engine=engine,
+            recorder=recorder,
         )
         dhb.hb.epoch = self.epoch - self.era
         return dhb

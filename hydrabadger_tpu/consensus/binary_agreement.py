@@ -23,6 +23,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, Hashable, Optional, Set, TypeVar
 
+from ..obs.recorder import resolve as _resolve_recorder
 from .threshold_sign import ThresholdSign
 from .types import NetworkInfo, Step, guarded_handler
 
@@ -56,6 +57,7 @@ class BinaryAgreement:
         coin_mode: str = "threshold",
         verify_coin_shares: bool = True,
         engine=None,
+        recorder=None,
     ):
         if coin_mode not in ("threshold", "hash"):
             raise ValueError("coin_mode must be 'threshold' or 'hash'")
@@ -64,6 +66,8 @@ class BinaryAgreement:
         self.coin_mode = coin_mode
         self.verify_coin_shares = verify_coin_shares
         self.engine = engine
+        self.obs = _resolve_recorder(recorder)
+        self._span_open = False
         self.round = 0
         self.estimate: Optional[bool] = None
         self.decision: Optional[bool] = None
@@ -72,17 +76,26 @@ class BinaryAgreement:
         self.received_term: Dict[bool, Set] = {False: set(), True: set()}
         self.term_sent = False
 
+    def __setstate__(self, state):
+        """Unpickle (sim checkpoint resume): recorder fields postdate
+        older snapshots; resumed instances never re-open their span."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("obs", _resolve_recorder(None))
+        self.__dict__.setdefault("_span_open", True)
+
     # -- API ----------------------------------------------------------------
 
     def propose(self, value: bool) -> Step:
         if self.estimate is not None or self.terminated:
             return Step()
+        self._obs_open()
         self.estimate = bool(value)
         return self._send_bval(self.round, bool(value))
 
     @guarded_handler("ba")
     def handle_message(self, sender, message) -> Step:
         _tag, rnd, content = message[0], int(message[1]), message[2]
+        self._obs_open()
         kind = content[0]
         if kind == "term":
             # Term is processed even after termination: a node whose
@@ -110,6 +123,11 @@ class BinaryAgreement:
         return Step().fault(sender, f"ba: unknown message {kind!r}")
 
     # -- round machinery ----------------------------------------------------
+
+    def _obs_open(self) -> None:
+        if not self._span_open:
+            self._span_open = True
+            self.obs.begin("ba")
 
     def _state(self, rnd: int) -> _RoundState:
         if rnd not in self.rounds:
@@ -272,6 +290,7 @@ class BinaryAgreement:
             # liveness for this instance is already gone if an adversary
             # kept the coin split for MAX_ROUNDS rounds.
             self.terminated = True
+            self.obs.end("ba", rounds=self.round, decision=None)
             return step.fault(
                 self.netinfo.our_id,
                 "ba: round bound exhausted without agreement",
@@ -304,6 +323,7 @@ class BinaryAgreement:
             return Step()
         self.decision = b
         self.terminated = True
+        self.obs.end("ba", rounds=self.round + 1, decision=bool(b))
         step = Step()
         step.output.append(b)
         if not self.term_sent and self.netinfo.our_index() is not None:

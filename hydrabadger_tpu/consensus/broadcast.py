@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, Hashable, Optional, TypeVar
 
 from ..crypto.engine import get_engine
+from ..obs.recorder import resolve as _resolve_recorder
 from .merkle import MerkleTree, Proof
 from .types import NetworkInfo, Step, Target, guarded_handler
 
@@ -29,10 +30,15 @@ MSG_READY = "bc_ready"
 class Broadcast:
     """One broadcast instance: `proposer_id` disseminates one payload."""
 
-    def __init__(self, netinfo: NetworkInfo, proposer_id, engine=None):
+    def __init__(self, netinfo: NetworkInfo, proposer_id, engine=None, recorder=None):
         self.netinfo = netinfo
         self.proposer_id = proposer_id
         self.engine = get_engine(engine)
+        # pure event emission only (obs/recorder.py): spans carry what
+        # this core knows (stage transitions); identity attrs and wall
+        # time arrive via binding/stamping at the layers above
+        self.obs = _resolve_recorder(recorder)
+        self._span_open = False
         n, f = netinfo.num_nodes, netinfo.num_faulty
         self.data_shards = n - 2 * f
         self.parity_shards = 2 * f
@@ -45,6 +51,13 @@ class Broadcast:
         self.readys: Dict = {}  # sender -> root bytes
         self.fault_estimate = 0
 
+    def __setstate__(self, state):
+        """Unpickle (sim checkpoint resume): recorder fields postdate
+        older snapshots; resumed instances never re-open their span."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("obs", _resolve_recorder(None))
+        self.__dict__.setdefault("_span_open", True)
+
     # -- API ----------------------------------------------------------------
 
     def broadcast(self, payload: bytes, rng=None) -> Step:
@@ -53,6 +66,7 @@ class Broadcast:
             raise ValueError("only the proposer may broadcast")
         if self.value_received:
             return Step.empty()
+        self._obs_open()
         shards = self.engine.rs_encode_bytes(
             payload, self.data_shards, self.parity_shards
         )
@@ -73,6 +87,7 @@ class Broadcast:
     @guarded_handler("broadcast")
     def handle_message(self, sender, message) -> Step:
         kind, payload = message[0], message[1]
+        self._obs_open()
         if kind == MSG_VALUE:
             return self._handle_value(sender, Proof.from_wire(payload))
         if kind == MSG_ECHO:
@@ -82,6 +97,11 @@ class Broadcast:
         return Step().fault(sender, f"broadcast: unknown message {kind!r}")
 
     # -- internals ----------------------------------------------------------
+
+    def _obs_open(self) -> None:
+        if not self._span_open:
+            self._span_open = True
+            self.obs.begin("rbc")
 
     def _n_leaves(self) -> int:
         return self.netinfo.num_nodes
@@ -168,6 +188,7 @@ class Broadcast:
                 slots, self.data_shards, self.parity_shards
             )
         except ValueError:
+            self.obs.instant("rbc_undecodable")
             return Step().fault(
                 self.proposer_id, "broadcast: undecodable shards"
             )
@@ -178,9 +199,11 @@ class Broadcast:
         )
         if MerkleTree(full).root != root:
             self.decided = True
+            self.obs.end("rbc", ok=False)
             return Step().fault(self.proposer_id, "broadcast: root mismatch")
         self.decided = True
         self.payload = payload
+        self.obs.end("rbc", ok=True, payload_bytes=len(payload))
         step = Step()
         step.output.append(payload)
         return step

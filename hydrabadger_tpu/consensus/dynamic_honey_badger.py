@@ -34,6 +34,7 @@ from typing import Dict, Hashable, List, Optional, Tuple, TypeVar
 
 from ..crypto.dkg import Ack, Part, SyncKeyGen
 from ..crypto.threshold import PublicKey, PublicKeySet, SecretKey
+from ..obs.recorder import resolve as _resolve_recorder
 from ..utils import codec
 from .honey_badger import Batch, HoneyBadger
 from .types import NetworkInfo, Step, guarded_handler
@@ -140,6 +141,7 @@ class DynamicHoneyBadger:
         verify_shares: bool = True,
         rng=None,
         engine=None,
+        recorder=None,
     ):
         self.our_id = our_id
         self.our_sk = our_sk
@@ -153,6 +155,7 @@ class DynamicHoneyBadger:
         self.verify_shares = verify_shares
         self.engine = engine
         self.rng = rng
+        self.obs = _resolve_recorder(recorder)
         self.hb = self._make_hb()
         self.votes: Dict = {}  # voter -> change (latest committed vote)
         self.our_vote: Optional[tuple] = None
@@ -179,6 +182,9 @@ class DynamicHoneyBadger:
             coin_mode=self.coin_mode,
             verify_shares=self.verify_shares,
             engine=self.engine,
+            # getattr: pre-obs pickled snapshots resume through here
+            recorder=getattr(self, "obs", None)
+            and self.obs.bind(era=self.era),
         )
 
     @classmethod
@@ -192,6 +198,7 @@ class DynamicHoneyBadger:
         verify_shares: bool = True,
         rng=None,
         engine=None,
+        recorder=None,
     ) -> "DynamicHoneyBadger":
         """Instantiate as an observer from a committed JoinPlan
         (the reference's `new_joining` path, state.rs:200-250)."""
@@ -214,9 +221,16 @@ class DynamicHoneyBadger:
             verify_shares=verify_shares,
             rng=rng,
             engine=engine,
+            recorder=recorder,
         )
         dhb.hb.epoch = plan.epoch - plan.era  # skip the era's earlier epochs
         return dhb
+
+    def __setstate__(self, state):
+        """Unpickle (sim checkpoint resume): the recorder field
+        postdates older snapshots."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("obs", _resolve_recorder(None))
 
     # -- API ----------------------------------------------------------------
 
@@ -784,6 +798,12 @@ class DynamicHoneyBadger:
         self.pub_keys = dict(state.new_pub_keys)
         self.era = new_era
         self.last_transcript = (new_era, kg_era, tuple(state.transcript))
+        getattr(self, "obs", _resolve_recorder(None)).instant(
+            "era_switch",
+            era=new_era,
+            validators=len(state.new_ids),
+            validator="yes" if sk_share is not None else "observer",
+        )
         self.hb = self._make_hb()
         self.votes = {}
         self.key_gen = None

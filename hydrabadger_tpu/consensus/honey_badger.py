@@ -17,6 +17,7 @@ from typing import Dict, Hashable, List, Optional, TypeVar
 
 from ..crypto.engine import get_engine
 from ..crypto.threshold import Ciphertext
+from ..obs.recorder import resolve as _resolve_recorder
 from .subset import Subset
 from .threshold_decrypt import ThresholdDecrypt
 from .types import NetworkInfo, Step, guarded_handler
@@ -45,6 +46,7 @@ class _EpochState:
     ciphertexts: Optional[dict] = None
     plaintexts: Dict = field(default_factory=dict)
     batch_done: bool = False
+    obs: object = None  # epoch-bound recorder view (obs/recorder.py)
 
 
 class HoneyBadger:
@@ -57,6 +59,7 @@ class HoneyBadger:
         verify_shares: bool = True,
         start_epoch: int = 0,
         engine=None,
+        recorder=None,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
@@ -64,12 +67,19 @@ class HoneyBadger:
         self.coin_mode = coin_mode
         self.verify_shares = verify_shares
         self.engine = get_engine(engine)
+        self.obs = _resolve_recorder(recorder)
         self.epoch = start_epoch
         self.epochs: Dict[int, _EpochState] = {}
         self.has_input: Dict[int, bool] = {}
         # messages beyond the pipelining window (a laggard's view of far-ahead
         # peers); buffered, not dropped — they are never resent
         self.deferred: List[tuple] = []
+
+    def __setstate__(self, state):
+        """Unpickle (sim checkpoint resume): the recorder field
+        postdates older snapshots."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("obs", _resolve_recorder(None))
 
     # -- API ----------------------------------------------------------------
 
@@ -144,6 +154,8 @@ class HoneyBadger:
 
     def _epoch_state(self, epoch: int) -> _EpochState:
         if epoch not in self.epochs:
+            eobs = self.obs.bind(epoch=epoch)
+            eobs.begin("epoch")
             self.epochs[epoch] = _EpochState(
                 Subset(
                     self.netinfo,
@@ -151,16 +163,23 @@ class HoneyBadger:
                     coin_mode=self.coin_mode,
                     verify_coin_shares=self.verify_shares,
                     engine=self.engine,
-                )
+                    recorder=eobs,
+                ),
+                obs=eobs,
             )
         return self.epochs[epoch]
 
     def _decrypt_instance(self, state: _EpochState, proposer) -> ThresholdDecrypt:
         if proposer not in state.decrypts:
+            pidx = self.netinfo.index(proposer)
+            # getattr: _EpochState instances unpickled from pre-obs
+            # checkpoints lack the field
+            eobs = getattr(state, "obs", None)
             state.decrypts[proposer] = ThresholdDecrypt(
                 self.netinfo,
                 verify_shares=self.verify_shares,
                 engine=self.engine,
+                recorder=eobs.bind(instance=pidx) if eobs is not None else None,
             )
         return state.decrypts[proposer]
 
@@ -216,6 +235,9 @@ class HoneyBadger:
                         if v is not None
                     },
                 )
+                eobs = getattr(state, "obs", None)
+                if eobs is not None:
+                    eobs.end("epoch", contributions=len(batch.contributions))
                 step.output.append(batch)
                 if epoch == self.epoch:
                     self.epoch = epoch + 1

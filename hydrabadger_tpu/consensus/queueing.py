@@ -30,6 +30,7 @@ class QueueingHoneyBadger:
         rng=None,
         auto_propose: bool = True,
         engine=None,
+        recorder=None,
     ):
         self.netinfo = netinfo
         self.batch_size = max(1, batch_size)
@@ -43,6 +44,7 @@ class QueueingHoneyBadger:
             coin_mode=coin_mode,
             verify_shares=verify_shares,
             engine=engine,
+            recorder=recorder,
         )
         self.batches: List[Batch] = []
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Dict, Hashable, Optional, TypeVar
 
+from ..obs.recorder import resolve as _resolve_recorder
 from .binary_agreement import BinaryAgreement
 from .broadcast import Broadcast
 from .types import NetworkInfo, Step, guarded_handler
@@ -32,12 +33,23 @@ class Subset:
         coin_mode: str = "threshold",
         verify_coin_shares: bool = True,
         engine=None,
+        recorder=None,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
+        self.obs = _resolve_recorder(recorder)
+        self._span_open = False
+        # each child instance gets the recorder bound to its proposer
+        # slot, so RBC/BA spans correlate to a subset lane without the
+        # child cores knowing the schema
         self.broadcasts: Dict = {
-            nid: Broadcast(netinfo, nid, engine=engine)
-            for nid in netinfo.node_ids
+            nid: Broadcast(
+                netinfo,
+                nid,
+                engine=engine,
+                recorder=self.obs.bind(instance=i),
+            )
+            for i, nid in enumerate(netinfo.node_ids)
         }
         self.agreements: Dict = {
             nid: BinaryAgreement(
@@ -46,6 +58,7 @@ class Subset:
                 coin_mode=coin_mode,
                 verify_coin_shares=verify_coin_shares,
                 engine=engine,
+                recorder=self.obs.bind(instance=i),
             )
             for i, nid in enumerate(netinfo.node_ids)
         }
@@ -56,10 +69,18 @@ class Subset:
         self.decided = False
         self.result: Optional[dict] = None
 
+    def __setstate__(self, state):
+        """Unpickle (sim checkpoint resume): recorder fields postdate
+        older snapshots; resumed instances never re-open their span."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("obs", _resolve_recorder(None))
+        self.__dict__.setdefault("_span_open", True)
+
     # -- API ----------------------------------------------------------------
 
     def propose(self, value: bytes) -> Step:
         """Contribute our payload (validators only)."""
+        self._obs_open()
         bc = self.broadcasts.get(self.netinfo.our_id)
         if bc is None:
             return Step()
@@ -72,6 +93,7 @@ class Subset:
     @guarded_handler("subset")
     def handle_message(self, sender, message) -> Step:
         _tag, pidx, inner = message[0], int(message[1]), message[2]
+        self._obs_open()
         if not 0 <= pidx < self.netinfo.num_nodes:
             return Step().fault(sender, "subset: bad proposer index")
         proposer = self.netinfo.node_ids[pidx]
@@ -92,6 +114,11 @@ class Subset:
         return step
 
     # -- internals ----------------------------------------------------------
+
+    def _obs_open(self) -> None:
+        if not self._span_open:
+            self._span_open = True
+            self.obs.begin("subset")
 
     def _wrap(self, proposer, message) -> tuple:
         return (MSG, self.netinfo.index(proposer), message)
@@ -199,6 +226,7 @@ class Subset:
                     for nid, dec in sorted(self.ba_results.items())
                     if dec
                 }
+                self.obs.end("subset", accepted=len(self.result))
                 step.output.append(self.result)
         # newly-produced sub-steps may have terminated more instances
         if step.messages and not self.decided:

@@ -11,6 +11,7 @@ from typing import Dict, Hashable, Optional, TypeVar
 
 from ..crypto.engine import get_engine
 from ..crypto.threshold import Ciphertext, DecryptionShare
+from ..obs.recorder import resolve as _resolve_recorder
 from .types import NetworkInfo, Step, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
@@ -20,11 +21,17 @@ MSG_DEC_SHARE = "td_share"
 
 class ThresholdDecrypt:
     def __init__(
-        self, netinfo: NetworkInfo, verify_shares: bool = True, engine=None
+        self,
+        netinfo: NetworkInfo,
+        verify_shares: bool = True,
+        engine=None,
+        recorder=None,
     ):
         self.netinfo = netinfo
         self.verify_shares = verify_shares
         self.engine = get_engine(engine)
+        self.obs = _resolve_recorder(recorder)
+        self._span_open = False
         self.ciphertext: Optional[Ciphertext] = None
         self.shares: Dict = {}
         self.pending: Dict = {}  # shares that arrived before the ciphertext
@@ -32,12 +39,20 @@ class ThresholdDecrypt:
         self.terminated = False
         self.plaintext: Optional[bytes] = None
 
+    def __setstate__(self, state):
+        """Unpickle (sim checkpoint resume): recorder fields postdate
+        older snapshots; resumed instances never re-open their span."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("obs", _resolve_recorder(None))
+        self.__dict__.setdefault("_span_open", True)
+
     def set_ciphertext(self, ct: Ciphertext, check: bool = True) -> Step:
         """Install the ciphertext and contribute our share."""
         if self.ciphertext is not None:
             return Step()
         if check and not ct.verify():
             raise ValueError("invalid ciphertext")
+        self._obs_open()
         self.ciphertext = ct
         step = Step()
         if self.netinfo.sk_share is not None:
@@ -52,6 +67,7 @@ class ThresholdDecrypt:
     @guarded_handler("threshold_decrypt")
     def handle_message(self, sender, message) -> Step:
         kind, payload = message[0], message[1]
+        self._obs_open()
         if kind != MSG_DEC_SHARE:
             return Step().fault(sender, f"threshold_decrypt: unknown {kind!r}")
         try:
@@ -62,6 +78,11 @@ class ThresholdDecrypt:
             self.pending[sender] = share
             return Step()
         return self._handle_share(sender, share)
+
+    def _obs_open(self) -> None:
+        if not self._span_open:
+            self._span_open = True
+            self.obs.begin("tdec")
 
     def _handle_share(self, sender, share: DecryptionShare) -> Step:
         """Share verification is DEFERRED to quorum time: hbbft verifies
@@ -113,5 +134,6 @@ class ThresholdDecrypt:
         )
         self.terminated = True
         self.plaintext = plaintext
+        self.obs.end("tdec", shares=len(self.shares))
         step.output.append(plaintext)
         return step

@@ -159,6 +159,17 @@ SECRET_SAFE_CALLS = frozenset({"len", "type", "isinstance", "id", "qsize"})
 # Logger variable names: a call on one of these is a logging sink.
 LOG_NAMES = frozenset({"log", "logger", "logging"})
 
+# Obs emitter bindings (hbtrace recorders / bound views): a call on one
+# of these is a logging sink too — trace events are exported to disk
+# and loaded into viewers, so key material reaching an emitter
+# (``self.obs.emit(..., sk)``) is exactly as bad as logging it.  Exact
+# names cover ``recorder`` handles and the ``rec``/``_rec`` internals
+# of obs/recorder.py; any binding whose name ENDS in ``obs`` (``obs``,
+# ``eobs``, ``epoch_obs``, ``hb_obs`` — the bound-view idiom) matches
+# via lint/secrets.py:_obs_binding.
+OBS_EMIT_NAMES = frozenset({"recorder", "rec", "_rec"})
+OBS_EMIT_SUFFIX = "obs"
+
 # --------------------------------------------------------------------------
 # retrace-budget
 # --------------------------------------------------------------------------

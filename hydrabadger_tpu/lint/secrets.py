@@ -7,7 +7,10 @@ f-string in a ``raise`` turns a 2^-128 security level into a grep.
 This pass tracks key material interprocedurally and reports it
 reaching:
 
-  * a **logging call** (any call on a ``log``/``logger`` binding);
+  * a **logging call** (any call on a ``log``/``logger`` binding) or an
+    **obs emission** (any call on an ``obs``/``recorder`` binding —
+    trace events are exported to disk, so secrets can never enter one;
+    ``registry.OBS_EMIT_NAMES``);
   * an **exception message** (a secret-tainted argument to a ``raise``d
     constructor, including f-string interpolation);
   * ``repr()`` / ``str()`` / ``print()``;
@@ -108,6 +111,14 @@ class SecretPolicy(Policy):
         return fi.cls if fi is not None else qual.rsplit("::", 1)[-1]
 
 
+def _obs_binding(name: str) -> bool:
+    """Is ``name`` an obs emitter binding?  Exact registry names plus
+    the ``*obs`` suffix idiom (``obs``, ``eobs``, ``epoch_obs``…)."""
+    return name in registry.OBS_EMIT_NAMES or name.endswith(
+        registry.OBS_EMIT_SUFFIX
+    )
+
+
 # -- sink scanning -----------------------------------------------------------
 
 
@@ -155,33 +166,37 @@ class _SecretScanner:
             visit(stmt)
 
     def _scan_expr(self, fi, stmt, expr, secret, in_raise) -> None:
+        from . import dotted_name
+
         for sub in ast.walk(expr):
             if not isinstance(sub, ast.Call):
                 continue
-            dn_parts = []
-            if isinstance(sub.func, ast.Attribute):
-                base = sub.func.value
-                if isinstance(base, ast.Name):
-                    dn_parts = [base.id, sub.func.attr]
-            elif isinstance(sub.func, ast.Name):
-                dn_parts = [sub.func.id]
+            # full dotted resolution so attribute-chained sinks are seen
+            # too: ``self.obs.emit(...)`` is [self, obs, emit]
+            dn = dotted_name(sub.func)
+            dn_parts = dn.split(".") if dn else []
             if dn_parts and (
                 dn_parts[-1] in registry.SECRET_SAFE_CALLS
                 or dn_parts[-1] in registry.SECRET_SEAL_FUNCS
             ):
                 continue  # len(secret) inside a raise is fine
             args = list(sub.args) + [kw.value for kw in sub.keywords]
-            # 1. logging
+            # 1. logging + obs emission (trace events are exported —
+            # registry.OBS_EMIT_NAMES/_SUFFIX make an emitter a sink)
             if (
-                len(dn_parts) == 2
-                and dn_parts[0] in registry.LOG_NAMES
+                len(dn_parts) >= 2
+                and (
+                    dn_parts[-2] in registry.LOG_NAMES
+                    or _obs_binding(dn_parts[-2])
+                )
                 and any(secret(a, stmt) for a in args)
             ):
                 self._emit(
                     fi.relpath,
                     sub,
-                    f"key material reaches logging in {fi.name!r} — log a "
-                    "digest or redact; never the share/key itself",
+                    f"key material reaches logging/obs emission in "
+                    f"{fi.name!r} — log a digest or redact; never the "
+                    "share/key itself",
                 )
             # 2. exception messages (constructor args inside a raise)
             elif in_raise and any(secret(a, stmt) for a in args):
@@ -205,9 +220,9 @@ class _SecretScanner:
                 )
             # 4. serialization toward wire/disk
             elif (
-                dn_parts
+                len(dn_parts) >= 2
                 and dn_parts[-1] == "encode"
-                and dn_parts[0] in ("codec",)
+                and dn_parts[-2] in ("codec",)
                 and any(secret(a, stmt) for a in args)
             ):
                 self._emit(

@@ -24,7 +24,6 @@ runs — the network plane only moves bytes.
 from __future__ import annotations
 
 import asyncio
-import logging
 import random
 import time as _time
 from collections import deque
@@ -36,12 +35,15 @@ from ..consensus.types import NetworkInfo, Step
 from ..crypto.dkg import Ack, Part, SyncKeyGen
 from ..crypto.engine import get_engine
 from ..crypto.threshold import PublicKey, SecretKey, Signature
+from ..obs.logging import get_logger
+from ..obs.metrics import MetricsRegistry
+from ..obs.recorder import resolve as _resolve_recorder
 from ..utils.ids import InAddr, OutAddr, Uid
 from . import wire
 from .peer import Peer, Peers
 from .wire import WireMessage, WireStream
 
-log = logging.getLogger("hydrabadger_tpu.net")
+log = get_logger("hydrabadger_tpu.net")
 
 # Pre-handshake frame parking budgets (per connection): frames that race
 # ahead of the handshake are held and replayed on establish, but an
@@ -229,10 +231,18 @@ class Hydrabadger:
         config: Optional[Config] = None,
         uid: Optional[Uid] = None,
         seed: Optional[int] = None,
+        recorder=None,
     ):
         self.uid = uid or Uid()
         self.bind = bind
         self.cfg = config or Config()
+        # hbtrace: the recorder is THE stamping boundary for this node's
+        # consensus cores (handler poll = one stamp); metrics registry
+        # is per-node so multi-node harnesses don't cross streams
+        self.obs = _resolve_recorder(recorder).bind(
+            node=self.uid.bytes.hex()[:8]
+        )
+        self.metrics = MetricsRegistry()
         # seed=None must mean real entropy: the uid is broadcast in every
         # hello frame, so deriving the RNG (hence the identity secret key
         # and encryption randomness) from it would be publicly replayable.
@@ -394,6 +404,7 @@ class Hydrabadger:
             verify_shares=node.cfg.verify_shares,
             rng=node.rng,
             engine=node.cfg.engine,
+            recorder=node.obs,
         )
         node.current_epoch = ckpt.epoch
         node.state = "validator" if ckpt.sk_share else "observer"
@@ -447,7 +458,7 @@ class Hydrabadger:
         addr = writer.get_extra_info("peername") or ("?", 0)
         out_addr = OutAddr(addr[0], addr[1])
         stream = WireStream(reader, writer, self.secret_key, self.cfg.wire_sign)
-        peer = Peer(out_addr, stream)
+        peer = Peer(out_addr, stream, metrics=self.metrics)
         peer.start_pump()
         self.peers.add(peer)
         try:
@@ -498,7 +509,7 @@ class Hydrabadger:
         stream = WireStream(
             reader, writer, self.secret_key, self.cfg.wire_sign
         )
-        peer = Peer(remote, stream, outgoing=True)
+        peer = Peer(remote, stream, outgoing=True, metrics=self.metrics)
         peer.start_pump()
         self.peers.add(peer)
         peer.send(
@@ -533,6 +544,7 @@ class Hydrabadger:
         try:
             self._internal.put_nowait(item)
         except asyncio.QueueFull:
+            self.metrics.counter("internal_queue_overflows").inc()
             if len(self._overflow_tasks) >= 1024:
                 # a node this far past its flood ceiling is not making
                 # progress; dropping (loudly) beats unbounded tasks
@@ -576,6 +588,35 @@ class Hydrabadger:
                     log.exception("keygen poll flush failed")
             finally:
                 self._kg_poll = None
+            # the poll boundary is THE stamping point: everything the
+            # cores emitted while this poll drained becomes externally
+            # visible now — and the bounded queues get sampled at the
+            # same cadence (depth + high-water, obs/metrics)
+            self._obs_poll()
+
+    def _obs_poll(self) -> None:
+        """Per-poll metrics sample + trace stamp: every PR-3 bounded
+        queue exports current depth and high-water through one gauge."""
+        m = self.metrics
+        m.gauge("internal_queue_depth").track(self._internal.qsize())
+        m.gauge("wire_retry_depth").track(len(self._wire_retry))
+        m.gauge("epoch_outbox_depth").track(len(self._epoch_outbox))
+        m.gauge("keygen_outbox_depth").track(len(self.keygen_outbox))
+        m.gauge("keygen_inbox_depth").track(len(self.keygen_inbox))
+        m.gauge("iom_queue_depth").track(len(self.iom_queue))
+        m.gauge("pending_user_depth").track(len(self._pending_user))
+        if self.key_gen is not None:
+            m.gauge("pending_acks_depth").track(
+                len(self.key_gen.pending_acks)
+            )
+        depth = 0
+        for p in self.peers.by_addr.values():
+            q = p.send_queue.qsize()
+            if q > depth:
+                depth = q
+        m.gauge("peer_send_queue_depth").track(depth)
+        if self.obs.enabled:
+            self.obs.stamp(_time.time())
 
     def _preverify_batch(self, batch: List[tuple]) -> None:
         """Amortised wire-signature checks (SURVEY.md §7 hard part 3).
@@ -687,6 +728,9 @@ class Hydrabadger:
         preverified: Optional[bool] = None,
     ) -> None:
         kind = msg.kind
+        # per-kind rx counters: the name space is bounded by the fixed
+        # wire.KINDS set (WireMessage construction enforces membership)
+        self.metrics.counter("wire_rx_" + kind).inc()
         if kind in wire.VERIFIED_KINDS:
             if peer.uid is None:
                 # frame raced ahead of this connection's handshake: park
@@ -1096,6 +1140,7 @@ class Hydrabadger:
                 verify_shares=self.cfg.verify_shares,
                 rng=self.rng,
                 engine=self.cfg.engine,
+                recorder=self.obs,
             )
             self.key_gen = None
             # keep the outbox: stragglers behind a healing link still need
@@ -1166,6 +1211,7 @@ class Hydrabadger:
             verify_shares=self.cfg.verify_shares,
             rng=self.rng,
             engine=self.cfg.engine,
+            recorder=self.obs,
         )
         self.state = "observer"
         self._last_progress_t = _time.monotonic()  # see _maybe_finish_keygen
@@ -1287,6 +1333,14 @@ class Hydrabadger:
         self._last_progress_t = now
         self._replay_backoff = 1.0
         self._replayed_since_progress = False
+        self.metrics.counter("epochs_committed").inc()
+        self.metrics.histogram("epoch_duration_s").observe(dt)
+        self.obs.instant(
+            "epoch_commit",
+            epoch=batch.epoch,
+            era=batch.era,
+            contributions=len(batch.contributions),
+        )
         # (The outbox is pruned, NOT cleared: the same Step that commits
         # epoch e already recorded our first epoch-e+1 frames — tagged e
         # at dispatch time, so the `< batch.epoch` sweep keeps them for
@@ -1465,6 +1519,7 @@ class Hydrabadger:
                 if attempts + 1 < WIRE_RETRY_CAP:
                     self._wire_retry.append((uid, msg, attempts + 1))
                 else:
+                    self.metrics.counter("wire_retry_dropped").inc()
                     log.warning(
                         "dropping targeted frame to %s after %d attempts",
                         uid,
@@ -1511,6 +1566,7 @@ class Hydrabadger:
             self._replay_backoff = min(self._replay_backoff * 2.0, 16.0)
             self._last_replay_t = now
             self._replayed_since_progress = True
+            self.metrics.counter("epoch_replays").inc()
             frames = list(self._epoch_outbox)
             log.debug(
                 "%s epoch stalled %.1fs (ema %.1fs): replaying %d frames",

@@ -9,15 +9,15 @@ state per peer (peer.rs:219-236), and broadcast helpers
 from __future__ import annotations
 
 import asyncio
-import logging
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional
 
 from ..crypto.threshold import PublicKey
+from ..obs.logging import get_logger
 from ..utils.ids import InAddr, OutAddr, Uid
 from .wire import WireMessage, WireStream
 
-log = logging.getLogger("hydrabadger_tpu.net.peer")
+log = get_logger("hydrabadger_tpu.net.peer")
 
 # Per-peer outbound backlog ceiling.  The pump drains the queue onto the
 # socket; a peer that stops reading (slow-loris) freezes the pump on TCP
@@ -46,6 +46,9 @@ class Peer:
     # same race in its wire retry queue (handler.rs:660-670)
     parked: List[tuple] = field(default_factory=list)
     parked_bytes: int = 0  # cumulative body bytes parked (budgeted)
+    # obs/metrics registry of the owning node (set when the node adopts
+    # the connection); per-frame tx counters + overflow events land here
+    metrics: Optional[object] = None
 
     def establish(self, uid: Uid, in_addr: InAddr, pk: PublicKey) -> None:
         self.uid = uid
@@ -71,7 +74,11 @@ class Peer:
             self.pump_task = asyncio.create_task(self._pump())
 
     def send(self, msg: WireMessage) -> None:
+        if self.metrics is not None:
+            self.metrics.counter("wire_tx_frames").inc()
         if self.send_queue.qsize() >= SEND_QUEUE_CAP:
+            if self.metrics is not None:
+                self.metrics.counter("peer_send_queue_overflows").inc()
             # a peer not draining thousands of frames is dead or
             # hostile; dropping the CONNECTION (not silently the frame)
             # routes recovery through the salvage/wire-retry path.  The

@@ -1,0 +1,53 @@
+"""hbtrace — the sans-io tracing + metrics plane.
+
+The consensus cores are pure state machines (consensus/types.py): they
+may never read a clock, so they cannot time themselves — yet the
+ROADMAP's production north star needs exactly that visibility (where do
+epochs go: RBC dissemination, ABA rounds, subset convergence, threshold
+decryption?).  This package splits the concern the same way the sans-io
+contract splits everything else:
+
+  * ``obs.recorder`` — cores emit pure structured events (epoch, era,
+    instance, stage) into a :class:`Recorder` with NO timestamp; the
+    I/O boundary (``net/node.py``'s handler poll, ``sim/router.py``'s
+    delivery loop) calls :meth:`Recorder.stamp` to assign wall-clock
+    time to everything emitted since the last stamp.  Cores stay
+    deterministic and lint-clean; traces stay truthful to when effects
+    became externally visible.
+  * ``obs.metrics`` — process-local counters / gauges (with high-water
+    marks) / fixed-edge histograms, exported as one JSON snapshot.
+    Every PR-3 bounded queue reports depth + high-water here.
+  * ``obs.retrace`` — runtime mirrors of the static ``RETRACE_BUDGETS``
+    declarations: each accelerated dispatch notes its shape signature,
+    and a teardown check fails loudly when reality drifts past the
+    declared bucket budget (lint/retrace_budget.py checks the code;
+    this checks the run).
+  * ``obs.export`` — JSONL and Chrome-trace-event (perfetto-loadable)
+    dumps of recorded events, plus the readers the round-trip tests
+    pin.
+  * ``obs.logging`` — the structured logger the net plane uses instead
+    of ad-hoc ``HYDRABADGER_LOG`` parsing in ``__main__``; levels and
+    per-module filters are preserved, and warning+ records can mirror
+    into a recorder as instant events.
+
+Secrets can never enter a trace: lint's secret-taint pass treats every
+obs emitter as a logging sink (lint/registry.py:OBS_EMIT_NAMES), so a
+``SecretKey`` reaching ``obs.emit(...)`` is a CI failure, not a leak.
+"""
+from __future__ import annotations
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, default_registry
+from .recorder import NULL_RECORDER, Event, NullRecorder, Recorder, resolve
+
+__all__ = [
+    "Counter",
+    "Event",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL_RECORDER",
+    "NullRecorder",
+    "Recorder",
+    "default_registry",
+    "resolve",
+]

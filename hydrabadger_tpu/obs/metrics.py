@@ -1,0 +1,156 @@
+"""Process-local metrics: counters, gauges with high-water marks, and
+fixed-edge histograms.
+
+The registry is deliberately tiny — no labels-as-cardinality, no
+background threads, no wire protocol.  A metric name is a plain string
+minted at the call site; the PR-3 queue caps and wire-kind counters
+that feed it all draw names from fixed sets (the queue inventory, the
+``net/wire.py:KINDS`` frozenset), so the registry's size is bounded by
+construction even when the *values* counted are attacker-paced.
+
+``snapshot()`` returns one JSON-able dict — the shape soak rows, bench
+rows and the ``--metrics`` CLI flag all embed directly.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-set value plus the high-water mark since creation/reset —
+    the pair every bounded queue exports (current depth, worst depth)."""
+
+    __slots__ = ("value", "high_water")
+
+    def __init__(self):
+        self.value = 0
+        self.high_water = 0
+
+    def set(self, v) -> None:
+        self.value = v
+        if v > self.high_water:
+            self.high_water = v
+
+    track = set  # alias: `track` reads better at sampling sites
+
+
+# Default edges suit epoch/stage durations in seconds: 1 ms .. ~1 min.
+DEFAULT_EDGES: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Fixed-edge histogram: ``counts[i]`` counts observations ``v``
+    with ``edges[i-1] < v <= edges[i]``; ``counts[0]`` is ``v <=
+    edges[0]`` and ``counts[-1]`` the overflow bucket."""
+
+    __slots__ = ("edges", "counts", "total", "sum")
+
+    def __init__(self, edges: Optional[Sequence[float]] = None):
+        self.edges: Tuple[float, ...] = tuple(edges or DEFAULT_EDGES)
+        if list(self.edges) != sorted(self.edges):
+            raise ValueError("histogram edges must be sorted")
+        self.counts: List[int] = [0] * (len(self.edges) + 1)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for edge in self.edges:
+            if v <= edge:
+                break
+            i += 1
+        self.counts[i] += 1
+        self.total += 1
+        self.sum += v
+
+
+class MetricsRegistry:
+    """Name -> metric; get-or-create accessors, one-shot snapshot."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        # accessors race between the asyncio loop and sampler threads in
+        # bench harnesses; creation is the only mutate-the-dict moment
+        self._lock = threading.Lock()
+
+    def __getstate__(self):
+        """Picklable (sim checkpoints pickle the owning SimNetwork):
+        the creation lock is process-local, recreated on load."""
+        state = self.__dict__.copy()
+        state.pop("_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter())
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge())
+        return g
+
+    def histogram(
+        self, name: str, edges: Optional[Sequence[float]] = None
+    ) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(edges))
+        return h
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": {k: c.value for k, c in sorted(self._counters.items())},
+            "gauges": {
+                k: {"value": g.value, "high_water": g.high_water}
+                for k, g in sorted(self._gauges.items())
+            },
+            "histograms": {
+                k: {
+                    "edges": list(h.edges),
+                    "counts": list(h.counts),
+                    "total": h.total,
+                    "sum": round(h.sum, 6),
+                }
+                for k, h in sorted(self._histograms.items())
+            },
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry: retrace counters and other planes
+    without a natural owner record here."""
+    return _DEFAULT

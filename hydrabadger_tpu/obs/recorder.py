@@ -1,0 +1,190 @@
+"""Sans-io span/event recorder.
+
+Protocol cores emit *logical* events — span begins/ends and instants
+tagged with whatever the core actually knows (epoch, era, instance
+index, stage, message kind) — and never a timestamp: a core that read a
+clock would diverge across replicas, which is exactly what the sans-io
+contract forbids.  Events accumulate in a pending buffer until the I/O
+boundary that drove the core (the TCP handler poll, the sim router's
+delivery loop) calls :meth:`Recorder.stamp` with its own clock; every
+event emitted since the previous stamp gets that wall-clock time.  The
+result is honest: an event's timestamp is the moment its effects became
+externally observable, not some interior instant no replica could agree
+on.
+
+``bind(**attrs)`` returns a lightweight view that merges default
+attributes into every emission — the idiom for threading identity down
+a protocol stack without the cores knowing the schema::
+
+    hb_obs   = recorder.bind(node=our_id)          # net/sim layer
+    epoch_obs = hb_obs.bind(epoch=7)               # HoneyBadger
+    epoch_obs.begin("rbc", instance=3)             # Broadcast
+    epoch_obs.end("rbc", instance=3, decoded=True)
+
+Disabled tracing is the :data:`NULL_RECORDER` singleton whose methods
+are no-ops and whose ``bind`` returns itself, so the always-on hooks in
+the hot paths cost one attribute lookup and an empty call.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+# Span phases follow the Chrome trace-event vocabulary so the exporter
+# is a dumb mapping: B(egin)/E(nd) bracket a duration, "i" is an
+# instant, "C" a counter sample.
+PHASE_BEGIN = "B"
+PHASE_END = "E"
+PHASE_INSTANT = "i"
+PHASE_COUNTER = "C"
+
+# Default ring capacity: a 4-node full-crypto epoch emits a few hundred
+# events; 1<<18 holds hours of epochs before the ring starts dropping
+# the oldest (never the newest — a trace should end at the interesting
+# part, the present).
+DEFAULT_CAPACITY = 1 << 18
+
+
+@dataclass
+class Event:
+    """One structured trace event.  ``t`` is None until the I/O
+    boundary stamps it; cores never set it."""
+
+    name: str
+    phase: str
+    attrs: Dict = field(default_factory=dict)
+    t: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "ph": self.phase, "t": self.t, **self.attrs}
+
+
+class Recorder:
+    """Collects events; bounded by construction (ring buffer)."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.events: Deque[Event] = deque(maxlen=capacity)
+        self._pending: List[Event] = []
+        # pending is bounded too: a core driven forever between stamps
+        # (a broken harness) must not grow host memory; overflow drops
+        # the OLDEST pending events, mirroring the ring
+        self._pending_cap = capacity
+
+    # -- emission (core side: no clocks) ------------------------------------
+
+    def emit(self, name: str, phase: str = PHASE_INSTANT, **attrs) -> None:
+        if len(self._pending) >= self._pending_cap:
+            del self._pending[: self._pending_cap // 2]
+        self._pending.append(Event(name, phase, attrs))
+
+    def begin(self, name: str, **attrs) -> None:
+        self.emit(name, PHASE_BEGIN, **attrs)
+
+    def end(self, name: str, **attrs) -> None:
+        self.emit(name, PHASE_END, **attrs)
+
+    def instant(self, name: str, **attrs) -> None:
+        self.emit(name, PHASE_INSTANT, **attrs)
+
+    def counter(self, name: str, value, **attrs) -> None:
+        self.emit(name, PHASE_COUNTER, value=value, **attrs)
+
+    # -- stamping (I/O-boundary side: owns the clock) -----------------------
+
+    def stamp(self, t: float) -> int:
+        """Assign wall-clock ``t`` to every pending event and move them
+        into the stamped ring.  Returns how many events were stamped."""
+        if not self._pending:
+            return 0
+        pending, self._pending = self._pending, []
+        for ev in pending:
+            ev.t = t
+        self.events.extend(pending)
+        return len(pending)
+
+    # -- views ---------------------------------------------------------------
+
+    def bind(self, **attrs) -> "BoundRecorder":
+        return BoundRecorder(self, attrs)
+
+    def drain(self) -> List[Event]:
+        """All stamped events, oldest first; clears the ring."""
+        out = list(self.events)
+        self.events.clear()
+        return out
+
+
+class BoundRecorder:
+    """A view over a Recorder that merges default attrs into every
+    emission.  Explicit attrs win over bound ones."""
+
+    enabled = True
+
+    __slots__ = ("_rec", "_attrs")
+
+    def __init__(self, rec: Recorder, attrs: Dict):
+        self._rec = rec
+        self._attrs = attrs
+
+    def emit(self, name: str, phase: str = PHASE_INSTANT, **attrs) -> None:
+        self._rec.emit(name, phase, **{**self._attrs, **attrs})
+
+    def begin(self, name: str, **attrs) -> None:
+        self.emit(name, PHASE_BEGIN, **attrs)
+
+    def end(self, name: str, **attrs) -> None:
+        self.emit(name, PHASE_END, **attrs)
+
+    def instant(self, name: str, **attrs) -> None:
+        self.emit(name, PHASE_INSTANT, **attrs)
+
+    def counter(self, name: str, value, **attrs) -> None:
+        self.emit(name, PHASE_COUNTER, value=value, **attrs)
+
+    def bind(self, **attrs) -> "BoundRecorder":
+        return BoundRecorder(self._rec, {**self._attrs, **attrs})
+
+    def stamp(self, t: float) -> int:
+        return self._rec.stamp(t)
+
+
+class NullRecorder:
+    """Tracing disabled: every method is a no-op, ``bind`` returns the
+    same singleton — the zero-overhead default wired everywhere."""
+
+    enabled = False
+
+    def emit(self, name: str, phase: str = PHASE_INSTANT, **attrs) -> None:
+        pass
+
+    def begin(self, name: str, **attrs) -> None:
+        pass
+
+    def end(self, name: str, **attrs) -> None:
+        pass
+
+    def instant(self, name: str, **attrs) -> None:
+        pass
+
+    def counter(self, name: str, value, **attrs) -> None:
+        pass
+
+    def stamp(self, t: float) -> int:
+        return 0
+
+    def bind(self, **attrs) -> "NullRecorder":
+        return self
+
+    def drain(self) -> list:
+        return []
+
+
+NULL_RECORDER = NullRecorder()
+
+
+def resolve(recorder) -> object:
+    """``None`` -> the null singleton; anything else passes through."""
+    return NULL_RECORDER if recorder is None else recorder

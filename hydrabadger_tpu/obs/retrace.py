@@ -1,0 +1,115 @@
+"""Runtime retrace accounting: the dynamic half of ``RETRACE_BUDGETS``.
+
+``lint/retrace_budget.py`` proves *statically* that every jit
+entrypoint's call-site arguments derive from registered shape buckets,
+with at most ``RETRACE_BUDGETS[fn]`` bucketed dimensions.  But a static
+declaration can drift from reality — a bucket table edited without the
+budget, a new caller feeding a dimension the analysis models too
+coarsely — and the failure mode is silent: the process just recompiles
+on every poll, a minute per trace on XLA:CPU.
+
+This module closes the loop at runtime.  Each accelerated dispatch
+calls :func:`note` with the entrypoint's name and the shape signature
+actually fed to jit.  :func:`check` then verifies, per entrypoint:
+
+  * the entry is **declared** (in some module's ``RETRACE_BUDGETS`` or
+    in ``lint/registry.py:CONFIG_BOUNDED_JIT``) — an undeclared noted
+    entry means the instrumentation and the registry drifted apart;
+  * the number of signature dimensions that actually **vary** across
+    the run is within the declared budget — more varying dims than
+    declared means a dynamic dimension snuck past the buckets;
+  * no single dimension takes more than ``BUCKET_CAPACITY`` distinct
+    values — the ladder contract of ``_bucket`` itself.
+
+``tests/conftest.py`` runs :func:`check` at session teardown, so a
+drifted budget fails the tier-1 gate loudly instead of silently
+retracing in production.  Distinct-signature counts also land in the
+default metrics registry (``retrace_sigs_<entry>``), so ``--metrics``
+snapshots show compile-cache pressure per entrypoint.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from .metrics import default_registry
+
+_PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+
+# entry name -> set of shape signatures observed this process
+_signatures: Dict[str, Set[Tuple]] = {}
+
+
+def note(entry: str, *dims) -> None:
+    """Record one dispatch of ``entry`` with shape signature ``dims``."""
+    sigs = _signatures.setdefault(entry, set())
+    sigs.add(tuple(dims))
+    default_registry().gauge(f"retrace_sigs_{entry}").track(len(sigs))
+
+
+def observed() -> Dict[str, Set[Tuple]]:
+    return {k: set(v) for k, v in _signatures.items()}
+
+
+def reset() -> None:
+    _signatures.clear()
+
+
+def declared_budgets() -> Dict[str, int]:
+    """Every ``RETRACE_BUDGETS`` entry under ops/ and crypto/, parsed
+    statically (no jax import) with the same extractor the lint pass
+    uses — one source of truth for the dict shape."""
+    from ..lint.retrace_budget import SCOPE, module_budgets
+
+    out: Dict[str, int] = {}
+    for sub in SCOPE:
+        for path in sorted((_PACKAGE_ROOT / sub).glob("*.py")):
+            text = path.read_text()
+            if "RETRACE_BUDGETS" not in text:
+                continue
+            out.update(module_budgets(ast.parse(text)))
+    return out
+
+
+def check() -> List[str]:
+    """Violation messages for every noted entry whose observed
+    signatures exceed its declaration; empty when reality matches."""
+    from ..lint import registry as lint_registry
+
+    budgets = declared_budgets()
+    config_bounded = {
+        key.split("::", 1)[1] for key in lint_registry.CONFIG_BOUNDED_JIT
+    }
+    cap = lint_registry.BUCKET_CAPACITY
+    violations: List[str] = []
+    for entry, sigs in sorted(_signatures.items()):
+        if entry not in budgets:
+            if entry in config_bounded:
+                continue  # bounded by process config, not by buckets
+            violations.append(
+                f"{entry}: dispatches noted at runtime but no "
+                "RETRACE_BUDGETS / CONFIG_BOUNDED_JIT declaration covers "
+                "it — declare the entrypoint or drop the instrumentation"
+            )
+            continue
+        budget = budgets[entry]
+        ndims = max((len(s) for s in sigs), default=0)
+        varying = 0
+        for i in range(ndims):
+            values = {s[i] for s in sigs if len(s) > i}
+            if len(values) > 1:
+                varying += 1
+            if len(values) > cap:
+                violations.append(
+                    f"{entry}: signature dim {i} took {len(values)} "
+                    f"distinct values (> BUCKET_CAPACITY={cap}) — a "
+                    "dimension is bypassing its bucket ladder"
+                )
+        if varying > budget:
+            violations.append(
+                f"{entry}: {varying} signature dims varied at runtime "
+                f"but RETRACE_BUDGETS declares {budget} — the static "
+                "budget has drifted from reality"
+            )
+    return violations

@@ -812,6 +812,13 @@ def _pad_mul_batch(points: Sequence, scalars: Sequence[int], inf):
     if b != n:
         points = list(points) + [inf] * (b - n)
         scalars = list(scalars) + [0] * (b - n)
+    # batch-plane lane accounting (obs/metrics): identity-padding waste
+    # is invisible in wall time but pure dispatch overhead
+    from ..obs.metrics import default_registry as _reg
+
+    _reg().gauge("mul_batch_lanes").track(b)
+    _reg().counter("mul_batch_pad_lanes").inc(b - n)
+    _reg().counter("mul_batch_real_lanes").inc(n)
     return points, scalars, n
 
 

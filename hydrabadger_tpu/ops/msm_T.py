@@ -217,8 +217,17 @@ def g1_msm_batch(
     """
     if not jobs:
         return []
+    from ..obs import retrace as _retrace
+    from ..obs.metrics import default_registry as _reg
+
     n_jobs = len(jobs)
     limbs, flat_ks, b, s = _pack_jobs(jobs)
+    # lane-occupancy accounting: (b*s) lanes dispatched, how many carry
+    # real (point, scalar) work vs identity padding
+    real_lanes = sum(len(pts) for pts, _ks in jobs)
+    _reg().gauge("msm_batch_lanes").track(b * s)
+    _reg().counter("msm_pad_lanes").inc(b * s - real_lanes)
+    _reg().counter("msm_real_lanes").inc(real_lanes)
     tpu = _use_tpu()
     max_bits = max([k.bit_length() for k in flat_ks] + [1])
     if max_bits <= _SHORT_BITS:
@@ -227,12 +236,16 @@ def g1_msm_batch(
         n_win = _bucket(-(-max_bits // 4), floor=4)
         wins = scalars_to_windows(flat_ks, n_bits=4 * n_win)
         fn = _msm_windowed_T if tpu else _msm_windowed_xla
+        # runtime mirror of this module's RETRACE_BUDGETS declaration:
+        # every distinct (b, s, n_win) is one compile-cache entry
+        _retrace.note(fn.__name__, b, s, n_win)
         out = fn(
             jnp.asarray(limbs), jnp.asarray(wins.reshape(b, s, n_win))
         )
     else:
         w1, w2 = scalars_to_glv_windows(flat_ks)
         fn = _msm_glv_T if tpu else _msm_glv_xla
+        _retrace.note(fn.__name__, b, s)
         out = fn(
             jnp.asarray(limbs),
             jnp.asarray(w1.reshape(b, s, -1)),

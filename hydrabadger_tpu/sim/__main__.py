@@ -65,6 +65,16 @@ def main(argv=None) -> int:
     )
     p.add_argument("--json", action="store_true", help="emit metrics as JSON")
     p.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="record consensus spans and dump on exit: .jsonl -> one "
+        "event per line, anything else -> perfetto-loadable Chrome JSON",
+    )
+    p.add_argument(
+        "--metrics", default=None, metavar="PATH",
+        help="dump the sim's metrics registry (router queue gauge, "
+        "process-wide retrace/lane counters) as JSON on exit",
+    )
+    p.add_argument(
         "--checkpoint", default=None, metavar="PATH",
         help="write a full-state sim checkpoint when the run finishes",
     )
@@ -90,6 +100,12 @@ def main(argv=None) -> int:
             p.error(f"--{name} must be in [0, 1]")
     if args.checkpoint_every and not args.checkpoint:
         p.error("--checkpoint-every requires --checkpoint")
+    if args.resume and args.trace:
+        # a resumed SimNetwork's cores were built (and pickled) with the
+        # checkpoint's recorder bindings — a fresh recorder could not be
+        # rebound into them, so the flag would silently record nothing
+        p.error("--trace is not supported with --resume (trace the "
+                "original run instead)")
 
     fault_flags = [
         name
@@ -158,6 +174,7 @@ def main(argv=None) -> int:
             engine=args.engine,
             seed=args.seed,
             adversary=adversary,
+            trace=bool(args.trace),
         )
         net = SimNetwork(cfg)
 
@@ -174,6 +191,28 @@ def main(argv=None) -> int:
         if args.checkpoint:
             ckpt_mod.save_sim(args.checkpoint, net)
 
+    if args.trace:
+        from ..obs import export as obs_export
+
+        if args.trace.endswith(".jsonl"):
+            n = obs_export.write_jsonl(net.recorder.events, args.trace)
+        else:
+            n = obs_export.write_chrome_trace(net.recorder.events, args.trace)
+        print(f"trace: {n} events -> {args.trace}", file=sys.stderr)
+    if args.metrics:
+        from ..obs.metrics import default_registry
+
+        with open(args.metrics, "w") as fh:
+            json.dump(
+                {
+                    "sim": net.metrics.snapshot(),
+                    "process": default_registry().snapshot(),
+                    "queue_peaks": net.queue_peaks(),
+                },
+                fh,
+                indent=1,
+            )
+        print(f"metrics -> {args.metrics}", file=sys.stderr)
     if args.json:
         print(json.dumps(metrics.as_dict()))
     else:

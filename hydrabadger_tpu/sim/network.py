@@ -25,6 +25,8 @@ from ..consensus.queueing import QueueingHoneyBadger
 from ..consensus.types import NetworkInfo
 from ..crypto import threshold as th
 from ..crypto.engine import get_engine
+from ..obs.metrics import MetricsRegistry
+from ..obs.recorder import NULL_RECORDER, Recorder
 from .router import Router
 
 
@@ -60,6 +62,10 @@ class SimConfig:
     # bench/test toggling the plane cannot leak it process-wide into
     # later configs (ADVICE r5 / the bench.py:328 leak)
     tpu_dkg: Optional[bool] = None
+    # hbtrace: record consensus spans (RBC/BA/subset/tdec/epoch) into
+    # SimNetwork.recorder; the router stamps them at each delivery.
+    # Off by default — the null recorder keeps the hooks ~free.
+    trace: bool = False
 
 
 @contextmanager
@@ -149,6 +155,10 @@ class SimNetwork:
         )
         self.rng = random.Random(cfg.seed + 1)
         engine = get_engine(cfg.engine)
+        # one shared recorder, bound per node so spans carry identity;
+        # one shared registry (the sim is one process, unlike TCP)
+        self.recorder = Recorder() if getattr(cfg, "trace", False) else NULL_RECORDER
+        self.metrics = MetricsRegistry()
         if cfg.protocol == "qhb":
             self.nodes: Dict = {
                 nid: QueueingHoneyBadger(
@@ -158,6 +168,7 @@ class SimNetwork:
                     coin_mode=cfg.coin_mode,
                     verify_shares=cfg.verify_shares,
                     engine=engine,
+                    recorder=self.recorder.bind(node=nid),
                 )
                 for nid in self.ids
             }
@@ -177,6 +188,7 @@ class SimNetwork:
                     # per-node seed: DKG secrets must differ across nodes
                     rng=random.Random(cfg.seed * 1_000_003 + 2 + idx),
                     engine=engine,
+                    recorder=self.recorder.bind(node=nid),
                 )
                 for idx, nid in enumerate(self.ids)
             }
@@ -188,6 +200,8 @@ class SimNetwork:
             adversary=cfg.adversary,
             seed=cfg.seed + 3,
             shuffle=cfg.shuffle,
+            recorder=self.recorder,
+            metrics=self.metrics,
         )
         self._txn_counter = 0
         self.total_wall_s = 0.0  # cumulative across run() calls / resumes
@@ -199,6 +213,8 @@ class SimNetwork:
         self.__dict__.update(state)
         self.__dict__.setdefault("total_wall_s", 0.0)
         self.__dict__.setdefault("epoch_durations", [])
+        self.__dict__.setdefault("recorder", NULL_RECORDER)
+        self.__dict__.setdefault("metrics", MetricsRegistry())
 
     def _handle(self, me, sender, message):
         return self.nodes[me].handle_message(sender, message)
@@ -300,6 +316,11 @@ class SimNetwork:
         # checkpoints predate the field (see __setstate__)
         with _dkg_plane(getattr(self.cfg, "tpu_dkg", None)):
             self._run_epoch_inner()
+        # events emitted outside a router delivery (propose calls, the
+        # native-ACS batch application) are still pending: the epoch
+        # boundary is the sim's other I/O boundary
+        if self.recorder.enabled:
+            self.recorder.stamp(time.perf_counter())
 
     def _run_epoch_inner(self) -> None:
         t0 = time.perf_counter()
@@ -365,6 +386,28 @@ class SimNetwork:
                 else:
                     m.bytes_committed += len(txns)
         return m
+
+    def queue_peaks(self) -> dict:
+        """High-water marks of the sim tier's bounded buffers — the
+        analogue of the TCP soak's ``queue_peaks`` row field."""
+        deferred = max(
+            (len(self._hb(nid).deferred) for nid in self.ids), default=0
+        )
+        future = max(
+            (
+                len(getattr(self.nodes[nid], "future_msgs", ()))
+                for nid in self.ids
+            ),
+            default=0,
+        )
+        return {
+            "router_queue": self.metrics.gauge("router_queue_depth").high_water,
+            "deferred": deferred,
+            "future": future,
+        }
+
+    def _hb(self, nid):
+        return self.nodes[nid].hb
 
     def _batches(self, nid) -> List:
         return self.nodes[nid].batches

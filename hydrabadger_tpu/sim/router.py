@@ -10,10 +10,12 @@ benchmark simulator builds on.  Replaces the reference's
 from __future__ import annotations
 
 import random
+import time
 from collections import deque
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple, TypeVar
 
 from ..consensus.types import Step
+from ..obs.recorder import resolve as _resolve_recorder
 
 N = TypeVar("N", bound=Hashable)
 
@@ -36,12 +38,19 @@ class Router:
         adversary: Optional[Adversary] = None,
         seed: int = 0,
         shuffle: bool = False,
+        recorder=None,
+        metrics=None,
     ):
         self.node_ids = list(node_ids)
         self.handle = handle  # (our_id, sender, message) -> Step
         self.adversary = adversary
         self.rng = random.Random(seed)
         self.shuffle = shuffle
+        # hbtrace: the router IS the sim's I/O boundary — it stamps the
+        # cores' pending events after each delivery and exports its own
+        # queue depth (the sim analogue of the TCP handler queue)
+        self.obs = _resolve_recorder(recorder)
+        self.metrics = metrics
         # container by mode: a list supports the O(1) swap-pop random
         # pick shuffle needs; a deque supports the O(1) popleft FIFO
         # needs.  (deque.rotate for the random pick was O(queue) per
@@ -51,6 +60,13 @@ class Router:
         self.outputs: Dict[Any, List[Any]] = {nid: [] for nid in self.node_ids}
         self.faults: List[Tuple[Any, Any]] = []
         self.delivered = 0
+
+    def __setstate__(self, state):
+        """Unpickle (checkpoint resume): obs fields postdate older
+        snapshots."""
+        self.__dict__.update(state)
+        self.__dict__.setdefault("obs", _resolve_recorder(None))
+        self.__dict__.setdefault("metrics", None)
 
     def dispatch_step(self, sender, step: Step) -> None:
         """Queue a step's messages; record its outputs/faults."""
@@ -71,6 +87,12 @@ class Router:
 
     def _enqueue(self, sender, recipient, message) -> None:
         if len(self.queue) >= self.MAX_QUEUE:
+            # record the terminal depth BEFORE raising: the loud-ceiling
+            # post-mortem starts from the high-water gauge
+            if self.metrics is not None:
+                self.metrics.gauge("router_queue_depth").track(
+                    len(self.queue)
+                )
             raise RuntimeError(
                 "router queue exceeded MAX_QUEUE — livelocked cores or "
                 "an amplifying adversary schedule"
@@ -101,6 +123,10 @@ class Router:
         self.delivered += 1
         if step is not None:
             self.dispatch_step(recipient, step)
+        if self.metrics is not None:
+            self.metrics.gauge("router_queue_depth").track(len(self.queue))
+        if self.obs.enabled:
+            self.obs.stamp(time.perf_counter())
         return True
 
     def run(self, max_messages: int = 1_000_000) -> int:

@@ -86,6 +86,12 @@ def sim_soak(epochs: int = 1000, n_nodes: int = 16,
     )
     assert max_deferred <= 1000, f"deferred buffer blew up: {max_deferred}"
     assert _throughput_stable(net.epoch_durations[10:]), "throughput decayed"
+    # queue_peaks for the sim tier too (the tcp tier always had it):
+    # one schema across tiers, so SOAK.json rows diff cleanly.  The
+    # router entry is the gauge's own (monotone) high-water; deferred
+    # has no gauge, so the per-chunk max above folds in
+    peaks = dict(net.queue_peaks())
+    peaks["deferred"] = max(peaks["deferred"], max_deferred)
     return {
         "tier": "sim_native_acs",
         "epochs": committed,
@@ -94,6 +100,8 @@ def sim_soak(epochs: int = 1000, n_nodes: int = 16,
         "rss_end_mb": round(rss1, 1),
         "rss_growth_mb": round(rss1 - rss0, 1),
         "max_deferred": max_deferred,
+        "queue_peaks": peaks,
+        "metrics": net.metrics.snapshot(),
         "agreement_ok": m.agreement_ok,
     }
 
@@ -163,6 +171,9 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
                 peaks["outbox"] = max(peaks["outbox"], len(m._epoch_outbox))
         dt = time.perf_counter() - t0
         rss1 = rss_mb()
+        # fold every node's registry into one snapshot row: counters
+        # sum, gauges take the worst node (high-water semantics)
+        merged = _merge_metrics([m.metrics.snapshot() for m in nodes])
         for m in nodes:
             await m.stop()
         epochs_done = min(committed)
@@ -180,9 +191,34 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
             "rss_end_mb": round(rss1, 1),
             "rss_growth_mb": round(rss1 - rss0, 1),
             "queue_peaks": peaks,
+            "metrics": merged,
         }
 
     return asyncio.run(run())
+
+
+def _merge_metrics(snapshots: List[Dict]) -> Dict:
+    """Fold per-node registry snapshots: counters sum, gauges keep the
+    worst (value AND high_water), histograms add bucket counts."""
+    out: Dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for snap in snapshots:
+        for k, v in snap.get("counters", {}).items():
+            out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, g in snap.get("gauges", {}).items():
+            cur = out["gauges"].setdefault(k, {"value": 0, "high_water": 0})
+            cur["value"] = max(cur["value"], g["value"])
+            cur["high_water"] = max(cur["high_water"], g["high_water"])
+        for k, h in snap.get("histograms", {}).items():
+            cur = out["histograms"].get(k)
+            if cur is None or cur["edges"] != h["edges"]:
+                out["histograms"][k] = dict(h)
+            else:
+                cur["counts"] = [
+                    a + b for a, b in zip(cur["counts"], h["counts"])
+                ]
+                cur["total"] += h["total"]
+                cur["sum"] = round(cur["sum"] + h["sum"], 6)
+    return out
 
 
 def main(argv=None) -> int:

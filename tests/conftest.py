@@ -71,3 +71,24 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test under asyncio.run")
+
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _retrace_budget_guard():
+    """Runtime retrace-budget teardown check (obs/retrace.py): every
+    accelerated dispatch this session noted its shape signature; if the
+    observed signatures exceed what the static RETRACE_BUDGETS tables
+    declare, the declaration has drifted from reality — fail the run
+    loudly (a teardown ERROR) instead of silently retracing in
+    production."""
+    yield
+    from hydrabadger_tpu.obs import retrace
+
+    violations = retrace.check()
+    assert not violations, (
+        "retrace budget drift detected at session teardown:\n  "
+        + "\n  ".join(violations)
+    )

@@ -171,6 +171,16 @@ class DynamicHoneyBadger:
         # (era, entries) for the most recent era switch: served to stranded
         # added nodes so they can recover their share (see era_transcript)
         self.last_transcript: Optional[tuple] = None
+        # hbasync double-buffer: (parts_buf, settle) of the last committed
+        # batch's keygen-part flush, its row-RLC MSM still in flight on
+        # the device.  Settled (effects applied in submission order) at
+        # the next flush, at propose/external_contribution (before the
+        # pending_kg snapshot the acks must ride), at an era switch, and
+        # by drain_async()/__getstate__ — never reordered, never dropped.
+        self._kg_inflight: Optional[tuple] = None
+        # faults from settles that ran outside a live Step (propose /
+        # drain): prepended to the next step _filter processes
+        self._deferred_faults: List = []
 
     # -- construction helpers ----------------------------------------------
 
@@ -231,6 +241,16 @@ class DynamicHoneyBadger:
         postdates older snapshots."""
         self.__dict__.update(state)
         self.__dict__.setdefault("obs", _resolve_recorder(None))
+        self.__dict__.setdefault("_kg_inflight", None)
+        self.__dict__.setdefault("_deferred_faults", [])
+
+    def __getstate__(self):
+        """Pickle (sim checkpoints): an in-flight settle closure holds
+        device buffers and is not serializable — settle it first (the
+        effects are deterministic host state the snapshot must hold
+        anyway; any faults ride _deferred_faults, which pickles)."""
+        self._settle_keygen_inflight()
+        return self.__dict__
 
     # -- API ----------------------------------------------------------------
 
@@ -252,6 +272,10 @@ class DynamicHoneyBadger:
     def propose(self, contribution: bytes, rng) -> Step:
         if not self.is_validator:
             return Step()
+        # settle any in-flight keygen flush FIRST: its acks must ride
+        # THIS contribution's pending_kg snapshot, exactly where the
+        # synchronous path put them
+        self._settle_keygen_inflight()
         votes = []
         # re-send until our vote shows up in the committed tally: a slot
         # that decides 0 silently drops its contribution
@@ -268,6 +292,7 @@ class DynamicHoneyBadger:
         """The internal payload propose() would feed the ACS — user bytes
         plus pending votes and keygen messages — for an external (native)
         ACS run that bypasses the message plane."""
+        self._settle_keygen_inflight()  # acks ride this snapshot
         votes = []
         if (
             self.our_vote is not None
@@ -424,6 +449,11 @@ class DynamicHoneyBadger:
     def _filter(self, step: Step) -> Step:
         """Relabel era-scoped messages and post-process batches."""
         step.map_messages(lambda m: (MSG, self.era, m))
+        if self._deferred_faults:
+            # faults from settles that ran outside a live Step (propose /
+            # drain_async / pickling): surface them on the next step out
+            step.fault_log[:0] = self._deferred_faults
+            self._deferred_faults = []
         out = []
         faults = []
         for item in step.output:
@@ -729,18 +759,52 @@ class DynamicHoneyBadger:
             )
 
     def _flush_keygen_parts(self, parts_buf: List, step: Step) -> None:
-        """Settle all parts deferred from one committed batch: every
+        """Flush all parts deferred from one committed batch: every
         row/commitment RLC check runs as one batched MSM and the ack
         values seal through the batched channel plane
         (SyncKeyGen.handle_parts) — n host Pippengers and n^2 per-value
-        seal calls collapse into one call each per batch."""
+        seal calls collapse into one call each per batch.
+
+        Double-buffered (hbasync): with the futures plane on, batch
+        k's MSM is SUBMITTED here and left in flight while the host
+        commits the rest of the batch (vote pairings, other nodes'
+        work in an in-process runtime); its settle — verdicts fetched,
+        our acks appended to pending_kg — runs at the NEXT flush
+        (after batch k+1's submit, so the device never drains), at
+        propose/external_contribution (the acks must ride that
+        snapshot), or at an era switch.  Settles always apply in
+        submission order, so the effect sequence is bit-identical to
+        the synchronous path."""
         if not parts_buf:
             return
         state = self.key_gen
         if state is None:
             return
+        from ..crypto import futures as _futures
+
+        kg = state.key_gen
+        if _futures.enabled() and hasattr(kg, "handle_parts_submit"):
+            try:
+                settle = kg.handle_parts_submit(list(parts_buf))
+            except (ValueError, TypeError, KeyError):
+                # Defensive only — see the sync branch's rationale.
+                for proposer, _part in parts_buf:
+                    step.fault(proposer, "dhb: keygen part batch failed")
+                return
+            prev, self._kg_inflight = (
+                self._kg_inflight,
+                (list(parts_buf), settle),
+            )
+            if prev is not None:
+                # batch k+1 submitted above; NOW settle batch k — the
+                # double-buffer: one flush always in flight
+                self._settle_flush(prev, step)
+            return
+        # sync branch: a flush left in flight by a mid-run plane toggle
+        # must settle first (its acks precede this batch's in pending_kg)
+        self._settle_keygen_inflight(step)
         try:
-            outcomes = state.key_gen.handle_parts(parts_buf)
+            outcomes = kg.handle_parts(parts_buf)
         except (ValueError, TypeError, KeyError):
             # Defensive only: handle_parts judges malformed input via
             # outcomes (non-member senders included) and its batched
@@ -758,7 +822,48 @@ class DynamicHoneyBadger:
         for (proposer, _part), outcome in zip(parts_buf, outcomes):
             self._apply_part_outcome(proposer, outcome, step)
 
+    def _settle_flush(self, pending: tuple, step: Step) -> None:
+        """Apply one deferred flush's outcomes (fetch verdicts, emit
+        acks/faults) — the per-part containment of the sync path."""
+        parts_buf, settle = pending
+        try:
+            outcomes = settle()
+        except (ValueError, TypeError, KeyError):
+            for proposer, _part in parts_buf:
+                step.fault(proposer, "dhb: keygen part batch failed")
+            return
+        for (proposer, _part), outcome in zip(parts_buf, outcomes):
+            self._apply_part_outcome(proposer, outcome, step)
+
+    def _settle_keygen_inflight(self, step: Optional[Step] = None) -> None:
+        """Settle the in-flight keygen flush, if any.  Without a live
+        Step the faults are deferred to the next one out (_filter)."""
+        pending, self._kg_inflight = self._kg_inflight, None
+        if pending is None:
+            return
+        local = step if step is not None else Step()
+        self._settle_flush(pending, local)
+        if step is None and local.fault_log:
+            self._deferred_faults.extend(local.fault_log)
+
+    def drain_async(self) -> Step:
+        """Settle any in-flight device work and return its step — the
+        tick-boundary drain the sim calls after each router run (and
+        harness teardowns call so no future is ever dropped).  Faults
+        deferred by earlier step-less settles ride out here too: the
+        drain may be the last step this node ever emits."""
+        step = Step()
+        self._settle_keygen_inflight(step)
+        if self._deferred_faults:
+            step.fault_log[:0] = self._deferred_faults
+            self._deferred_faults = []
+        return step
+
     def _switch_era(self, step: Step) -> None:
+        # the in-flight flush belongs to the completing keygen: settle it
+        # BEFORE generate() and before pending_kg is cleared, so our acks
+        # land (and are cleared) exactly as on the synchronous path
+        self._settle_keygen_inflight(step)
         state = self.key_gen
         new_era = self.epoch
         kg_era = self.era  # the era this keygen's channel nonces used

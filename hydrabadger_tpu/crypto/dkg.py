@@ -160,26 +160,60 @@ def _tpu_msm_enabled(n_muls: int) -> bool:
     return mode == "forced" or (mode == "auto" and n_muls >= 256)
 
 
-def g1_msm_batch(jobs):
-    """Evaluate MANY independent MSMs: jobs is a sequence of
-    (points, scalars) pairs, returns one combined point per job.
+def g1_msm_batch_submit(jobs):
+    """Submit MANY independent MSMs and return a CryptoFuture of the
+    per-job combined points (crypto/futures).
 
     One device dispatch through the batched MSM plane (ops/msm_T) when
-    the TPU DKG plane is on and there is more than one job; otherwise
-    the native Pippenger / plain sum per job — the bit-exact fallback
-    (and the oracle ops/msm_T is pinned against).  This is the same
-    routing CryptoEngine.g1_msm_batch exposes to the protocol layers."""
+    the TPU DKG plane is on and there is more than one job — the
+    dispatch is issued NOW, the host materialization deferred into the
+    future, so the caller can do protocol work in the device's shadow;
+    otherwise an immediate future over the native Pippenger / plain
+    sum — the bit-exact fallback (and the oracle ops/msm_T is pinned
+    against).  This is the same routing CryptoEngine.submit_g1_msm_batch
+    exposes to the protocol layers."""
+    from .futures import immediate, msm_coalescer, submit
+
     jobs = list(jobs)
     if len(jobs) > 1 and _tpu_msm_enabled(sum(len(p) for p, _s in jobs)):
+        co = msm_coalescer()
+        if co is not None:
+            # in-process multi-node runtimes: queue into the per-tick
+            # coalescer — all nodes' jobs flush as ONE device dispatch
+            # at the first settle (crypto/futures.MsmCoalescer)
+            return co.submit(
+                jobs,
+                fallback=lambda: [
+                    g1_msm_or_fallback(p, s) for p, s in jobs
+                ],
+                label="dkg-msm",
+            )
         try:
             from ..ops import msm_T
 
-            return msm_T.g1_msm_batch(jobs)
+            fin = msm_T.g1_msm_batch_submit(jobs)
+
+            def _materialize():
+                try:
+                    return fin()
+                except ValueError:
+                    raise  # structural: loud on every route
+                except Exception:  # pragma: no cover - device failure
+                    return [g1_msm_or_fallback(p, s) for p, s in jobs]
+
+            return submit(_materialize, "dkg-msm")
         except ValueError:
             raise  # structural (length mismatch): loud on every route
         except Exception:  # pragma: no cover - device failure
             pass
-    return [g1_msm_or_fallback(p, s) for p, s in jobs]
+    return immediate(
+        [g1_msm_or_fallback(p, s) for p, s in jobs], "dkg-msm"
+    )
+
+
+def g1_msm_batch(jobs):
+    """Synchronous spelling of g1_msm_batch_submit: dispatch + fetch."""
+    return g1_msm_batch_submit(jobs).result()
 
 
 def _keystream_xor(key: bytes, ctx: bytes, data: bytes) -> bytes:
@@ -218,27 +252,46 @@ def _open(key: bytes, ctx: bytes, blob: bytes) -> Optional[bytes]:
 def _seal_batch(items) -> List[bytes]:
     """Seal a batch of (key, ctx, msg) channel values in one pass —
     bit-identical to _seal per item.  A 128-node era switch seals ~2M
-    values (n ack values per part, n parts, at every node); binding the
-    hash primitives once and inlining the single-block keystream (ack
-    values are 32 bytes) is worth ~2x Python overhead at that volume."""
+    values (n ack values per part, n parts, at every node); two
+    hoists carry the win at that volume:
+
+    * per-KEY digest contexts — the keystream prefix hash
+      ``sha256(key + b"|enc|")`` and the HMAC key schedule
+      ``hmac(key, b"|mac|")`` are both key-only; a poll seals to the
+      same n recipients for every part, so each key's setup (two
+      compression-function runs for the HMAC pads alone) runs once and
+      every later item pays a cheap ``copy()``;
+    * the single-block keystream inline (ack values are 32 bytes), as
+      before."""
     sha = hashlib.sha256
-    hdigest = hmac_mod.digest
+    enc_pre: Dict[bytes, object] = {}  # key -> sha256(key + b"|enc|")
+    mac_pre: Dict[bytes, object] = {}  # key -> hmac(key, b"|mac|")
     out = []
     for key, ctx, msg in items:
         n = len(msg)
+        e = enc_pre.get(key)
+        if e is None:
+            e = enc_pre[key] = sha(key + b"|enc|")
         if n <= 32:
-            ks = sha(key + b"|enc|" + ctx + b"\x00\x00\x00\x00").digest()[:n]
+            h = e.copy()
+            h.update(ctx + b"\x00\x00\x00\x00")
+            ks = h.digest()[:n]
         else:
-            prefix = key + b"|enc|" + ctx
-            parts = [
-                sha(prefix + ctr.to_bytes(4, "big")).digest()
-                for ctr in range((n + 31) // 32)
-            ]
+            parts = []
+            for ctr in range((n + 31) // 32):
+                h = e.copy()
+                h.update(ctx + ctr.to_bytes(4, "big"))
+                parts.append(h.digest())
             ks = b"".join(parts)[:n]
         ct = (
             int.from_bytes(msg, "big") ^ int.from_bytes(ks, "big")
         ).to_bytes(n, "big")
-        out.append(ct + hdigest(key, b"|mac|" + ctx + ct, "sha256")[:16])
+        m = mac_pre.get(key)
+        if m is None:
+            m = mac_pre[key] = hmac_mod.new(key, b"|mac|", "sha256")
+        t = m.copy()
+        t.update(ctx + ct)
+        out.append(ct + t.digest()[:16])
     return out
 
 
@@ -560,6 +613,12 @@ class SyncKeyGen(Generic[N]):
         self.parts: Dict[int, _ProposalState] = {}
         self._chan_keys: Dict[int, bytes] = {}
         self._our_pk_bytes = self.pub_keys[our_id].to_bytes()
+        # hoisted 2-byte index encodings: the channel-context builders
+        # run ~n^2 times per poll and n^3 times per era — re-encoding
+        # the same small ints each time was measurable at n=128
+        self._idx2 = [
+            m.to_bytes(2, "big") for m in range(len(self.node_ids))
+        ]
 
     # -- pairwise channels --------------------------------------------------
 
@@ -636,14 +695,21 @@ class SyncKeyGen(Generic[N]):
             + recipient.to_bytes(2, "big")
         )
 
-    def _val_ctx(self, proposer: int, sender: int, recipient: int) -> bytes:
+    def _val_ctx_prefix(self, proposer: int, sender: int) -> bytes:
+        """The recipient-independent prefix of _val_ctx: hoisted out of
+        the per-recipient inner seal loops (one bytes build per part
+        instead of n)."""
         return (
             b"V"
             + self.session
             + b"|"
             + proposer.to_bytes(2, "big")
             + sender.to_bytes(2, "big")
-            + recipient.to_bytes(2, "big")
+        )
+
+    def _val_ctx(self, proposer: int, sender: int, recipient: int) -> bytes:
+        return self._val_ctx_prefix(proposer, sender) + recipient.to_bytes(
+            2, "big"
         )
 
     # -- proposing ----------------------------------------------------------
@@ -652,11 +718,12 @@ class SyncKeyGen(Generic[N]):
         poly = BivarPoly.random(self.threshold, self.rng)
         commit = poly.commitment()
         self.warm_channel_keys()  # one batched derivation for the era
+        row_prefix = b"R" + self.session + b"|" + self._idx2[self.our_idx]
         enc_rows = _seal_batch(
             [
                 (
                     self._chan_key(m),
-                    self._row_ctx(self.our_idx, m),
+                    row_prefix + self._idx2[m],
                     codec.encode(poly.row(m + 1)),
                 )
                 for m in range(len(self.node_ids))
@@ -679,6 +746,10 @@ class SyncKeyGen(Generic[N]):
     def handle_parts(
         self, items: List[Tuple[N, Part]]
     ) -> List[PartOutcome]:
+        """Synchronous spelling of handle_parts_submit: submit + settle."""
+        return self.handle_parts_submit(items)()
+
+    def handle_parts_submit(self, items: List[Tuple[N, Part]]):
         """Record a POLL'S WORTH of proposals with batched crypto.
 
         Checks split into two classes with different consequences:
@@ -700,7 +771,20 @@ class SyncKeyGen(Generic[N]):
         on the 16-window short-scalar tier (the LHS stays a host
         base-point ladder — see the inline note), and the outgoing ack
         values for every acked part seal through the batched channel
-        plane instead of n host calls per part."""
+        plane instead of n host calls per part.
+
+        Async (round 7, hbasync): returns a zero-arg SETTLE closure.
+        The MSM is SUBMITTED before the closure is built; everything
+        the sync path ran after the MSM that does not depend on its
+        verdicts — the LHS base-point ladders, channel-key warming,
+        the per-recipient ack-value evaluation and sealing — runs
+        between submit and settle, in the device's shadow.  settle()
+        fetches the verdicts, drops the (rare, Byzantine-only) failed
+        rows' pre-sealed acks, and returns the outcome list —
+        bit-identical to the synchronous path in every recorded state
+        and emitted ack.  Callers may hold the closure across further
+        host work (the dhb double-buffer) but MUST invoke it before
+        the outcomes' effects are due."""
         outcomes: List[Optional[PartOutcome]] = [None] * len(items)
         pending = []  # (slot, proposer idx, state, row, raw, part)
         mode = _tpu_dkg_mode(self.threshold)
@@ -778,7 +862,7 @@ class SyncKeyGen(Generic[N]):
                 continue
             pending.append((i, s, state, row, raw, part))
         if not pending:
-            return outcomes  # type: ignore[return-value]
+            return lambda: outcomes  # type: ignore[return-value]
         # one RLC check per row instead of t+1 point equalities: with
         # random 64-bit r_k, sum r_k row[k] * G == sum r_k expected[k]
         # — a forged row passes with probability 2^-64.  All pending
@@ -790,7 +874,7 @@ class SyncKeyGen(Generic[N]):
         # the RLC scalars qualify for); one native G1 ladder per part
         # is noise next to the t+1-point MSM it gates.
         jobs = []
-        lhs_points = []
+        rs_list = []
         for _i, _s, state, row, raw, part in pending:
             expected = state.commitment.row_commitment(self.our_idx + 1)
             # Fiat-Shamir: the seed hashes the FULL commitment and FULL
@@ -802,37 +886,54 @@ class SyncKeyGen(Generic[N]):
                 + hashlib.sha256(bytes(raw)).digest()
             ).digest()
             rs = _rlc_scalars(seed, len(row))
-            lhs_scalar = sum(r * c for r, c in zip(rs, row)) % R
             jobs.append((list(expected), rs))
-            lhs_points.append(mul_sub(G1, lhs_scalar))
-        results = g1_msm_batch(jobs)
-        acked = []
-        for (i, s, state, row, _raw, _part), res, lhs_pt in zip(
-            pending, results, lhs_points
-        ):
-            if eq(res, lhs_pt):
-                acked.append((i, s, row))
-            else:
-                state.row = None
-                outcomes[i] = PartOutcome(
-                    False, fault="row/commitment mismatch", recorded=True
-                )
-        if acked:
-            self.warm_channel_keys()  # batch any keys still underived
-        for i, s, row in acked:
+            rs_list.append(rs)
+        fut = g1_msm_batch_submit(jobs)
+        # ---- host work in the device's shadow ----------------------------
+        # Everything below ran AFTER the MSM on the sync path and depends
+        # only on data known at submit time: the LHS ladders, channel-key
+        # warming, and the optimistic per-recipient ack evaluation+seal
+        # (discarded for the Byzantine-only rows the verdicts reject).
+        lhs_points = [
+            mul_sub(G1, sum(r * c for r, c in zip(rs, row)) % R)
+            for rs, (_i, _s, _st, row, _raw, _p) in zip(rs_list, pending)
+        ]
+        self.warm_channel_keys()  # batch any keys still underived
+        n_nodes = len(self.node_ids)
+        keys = [self._chan_key(m) for m in range(n_nodes)]
+        idx2 = self._idx2
+        pre_acks = []
+        for _i, s, _state, row, _raw, _part in pending:
             # our own consistent value: f_s(our_idx+1, our_idx+1)
-            enc_values = _seal_batch(
-                [
-                    (
-                        self._chan_key(m),
-                        self._val_ctx(s, self.our_idx, m),
-                        poly_eval(row, m + 1).to_bytes(32, "big"),
-                    )
-                    for m in range(len(self.node_ids))
-                ]
+            prefix = self._val_ctx_prefix(s, self.our_idx)
+            pre_acks.append(
+                _seal_batch(
+                    [
+                        (
+                            keys[m],
+                            prefix + idx2[m],
+                            poly_eval(row, m + 1).to_bytes(32, "big"),
+                        )
+                        for m in range(n_nodes)
+                    ]
+                )
             )
-            outcomes[i] = PartOutcome(True, ack=Ack(s, tuple(enc_values)))
-        return outcomes  # type: ignore[return-value]
+
+        def settle() -> List[PartOutcome]:
+            results = fut.result()
+            for (i, s, state, _row, _raw, _part), res, lhs_pt, enc in zip(
+                pending, results, lhs_points, pre_acks
+            ):
+                if eq(res, lhs_pt):
+                    outcomes[i] = PartOutcome(True, ack=Ack(s, tuple(enc)))
+                else:
+                    state.row = None
+                    outcomes[i] = PartOutcome(
+                        False, fault="row/commitment mismatch", recorded=True
+                    )
+            return outcomes  # type: ignore[return-value]
+
+        return settle
 
     def handle_ack(self, sender_id: N, ack: Ack) -> AckOutcome:
         """Count an ack.  STRUCTURAL checks (known part, value count,
@@ -876,6 +977,10 @@ class SyncKeyGen(Generic[N]):
         self._verify_values_batch([state])
 
     def _verify_values_batch(self, states) -> None:
+        """Synchronous spelling of _verify_values_batch_submit."""
+        self._verify_values_batch_submit(states)()
+
+    def _verify_values_batch_submit(self, states):
         """Settle MANY proposals' stored ack values: per proposal one
         RLC check — with random 64-bit r_m,
           (sum_m r_m v_m) * G == sum_j col[j] * (sum_m r_m (m+1)^j)
@@ -888,7 +993,13 @@ class SyncKeyGen(Generic[N]):
         row checks, folding the LHS here is free: the column weights
         w_j are full-width mod R anyway, so the batch is on the GLV
         tier with or without the fold.  On a job failure, the
-        per-value slow path drops exactly the bad entries."""
+        per-value slow path drops exactly the bad entries.
+
+        Returns a zero-arg settle closure (hbasync): the MSM is
+        submitted before returning, so the caller — generate()'s
+        commitment accumulation is the designed consumer — can run host
+        work in the device's shadow and settle when the verified values
+        are actually consumed."""
         pending = []  # (state, items, job points, job scalars)
         for state in states:
             if getattr(state, "values_verified", True) or not state.values:
@@ -931,20 +1042,25 @@ class SyncKeyGen(Generic[N]):
                 )
             )
         if not pending:
-            return
-        results = g1_msm_batch(
+            return lambda: None
+        fut = g1_msm_batch_submit(
             [(pts, ks) for _st, _it, pts, ks in pending]
         )
-        for (state, items, _pts, _ks), res in zip(pending, results):
-            if eq(res, infinity(FQ)):
+
+        def settle() -> None:
+            results = fut.result()
+            for (state, items, _pts, _ks), res in zip(pending, results):
+                if eq(res, infinity(FQ)):
+                    state.values_verified = True
+                    continue
+                # slow path: drop exactly the mismatching values
+                for mp, val in items:
+                    expected = g1_poly_eval(state.our_column, mp)
+                    if not eq(mul_sub(G1, val), expected):
+                        state.values.pop(mp, None)
                 state.values_verified = True
-                continue
-            # slow path: drop exactly the mismatching values
-            for mp, val in items:
-                expected = g1_poly_eval(state.our_column, mp)
-                if not eq(mul_sub(G1, val), expected):
-                    state.values.pop(mp, None)
-            state.values_verified = True
+
+        return settle
 
     # -- completion ---------------------------------------------------------
 
@@ -971,11 +1087,16 @@ class SyncKeyGen(Generic[N]):
             if state.is_complete(t)
         ]
         # settle ALL proposals' lazily-stored ack values with one
-        # batched MSM call (round 6) instead of one host MSM each
-        self._verify_values_batch(complete)
+        # batched MSM call (round 6) instead of one host MSM each —
+        # SUBMITTED first (hbasync), so the public-key-set accumulation
+        # below (t+1 point adds per proposal, pure host work that needs
+        # no verdicts) runs in the device's shadow
+        settle_values = self._verify_values_batch_submit(complete)
         for state in complete:
             row0 = state.commitment.row_commitment(0)
             commit_acc = [add(a, b) for a, b in zip(commit_acc, row0)]
+        settle_values()
+        for state in complete:
             # interpolate our share slice from VERIFIED ack values only;
             # 2t+1 structural acks guarantee >= t+1 of them carried
             # values that verify for us (honest ackers)

@@ -407,6 +407,66 @@ class CpuEngine:
             pk_set.combine_signatures(shares) for pk_set, shares in jobs
         ]
 
+    # -- asynchronous dispatch (crypto/futures) -----------------------------
+    #
+    # Every batched entry point has a future-returning twin: submit_* runs
+    # the dispatch NOW and returns a CryptoFuture whose result() performs
+    # the host materialization.  On the CPU engine the work is host work
+    # already, so the future is immediate — consumers written against the
+    # submit API stay engine-agnostic and bit-identical across engines
+    # (the deferral only changes WHEN the host blocks, never the value).
+
+    def submit_g1_msm_batch(self, jobs) -> "futures.CryptoFuture":
+        from . import futures
+
+        return futures.immediate(self.g1_msm_batch(jobs), "g1_msm_batch")
+
+    def submit_verify_decryption_shares_batch(
+        self, pk_shares, shares, ct
+    ) -> "futures.CryptoFuture":
+        from . import futures
+
+        return futures.immediate(
+            self.verify_decryption_shares_batch(pk_shares, shares, ct),
+            "verify_dec_shares",
+        )
+
+    def submit_sign_share_batch(self, items) -> "futures.CryptoFuture":
+        from . import futures
+
+        return futures.immediate(
+            self.sign_share_batch(items), "sign_share_batch"
+        )
+
+    def submit_decrypt_share_batch(self, items) -> "futures.CryptoFuture":
+        from . import futures
+
+        return futures.immediate(
+            self.decrypt_share_batch(items), "decrypt_share_batch"
+        )
+
+    def submit_rs_encode_batch(
+        self, data, data_shards: int, parity_shards: int
+    ) -> "futures.CryptoFuture":
+        from . import futures
+
+        return futures.immediate(
+            self.rs_encode_batch(data, data_shards, parity_shards),
+            "rs_encode_batch",
+        )
+
+    def submit_rs_reconstruct_batch(
+        self, surviving, rows, data_shards: int, parity_shards: int
+    ) -> "futures.CryptoFuture":
+        from . import futures
+
+        return futures.immediate(
+            self.rs_reconstruct_batch(
+                surviving, rows, data_shards, parity_shards
+            ),
+            "rs_reconstruct_batch",
+        )
+
     def __repr__(self) -> str:
         return f"<{type(self).__name__}>"
 
@@ -487,6 +547,87 @@ class TpuEngine(CpuEngine):
             [sk.scalar for sk, _msg in items],
         )
         return [th.SignatureShare(p) for p in points]
+
+    # -- asynchronous dispatch: device-plane deferrals ----------------------
+    #
+    # Where a device batch plane exists, submit_* dispatches it now (JAX
+    # enqueues and returns) and defers ONLY the host materialization —
+    # np.asarray / limbs_to_points — into the future.  The host can then
+    # run protocol work in the device's shadow; result() pays whatever
+    # wall remains.  Entry points without a device plane inherit the
+    # CpuEngine's immediate futures.
+
+    def submit_g1_msm_batch(self, jobs) -> "futures.CryptoFuture":
+        from . import futures
+
+        if not jobs:
+            return futures.immediate([], "g1_msm_batch")
+        from ..ops import msm_T
+
+        return futures.submit(
+            msm_T.g1_msm_batch_submit(jobs), "g1_msm_batch"
+        )
+
+    def submit_decrypt_share_batch(self, items) -> "futures.CryptoFuture":
+        from . import futures
+
+        if not items:
+            return futures.immediate([], "decrypt_share_batch")
+        from ..ops import bls_jax
+
+        fin = bls_jax.g1_scalar_mul_batch_submit(
+            [ct.u for _, ct in items], [sk.scalar for sk, _ in items]
+        )
+        return futures.submit(
+            lambda: [th.DecryptionShare(p) for p in fin()],
+            "decrypt_share_batch",
+        )
+
+    def submit_sign_share_batch(self, items) -> "futures.CryptoFuture":
+        from . import futures
+
+        if not items:
+            return futures.immediate([], "sign_share_batch")
+        from ..ops import bls_g2_jax
+
+        h_cache: Dict[bytes, tuple] = {}
+        for _sk, msg in items:
+            if msg not in h_cache:  # setdefault would hash eagerly
+                h_cache[msg] = th.hash_to_g2(msg)
+        fin = bls_g2_jax.g2_scalar_mul_batch_submit(
+            [h_cache[msg] for _sk, msg in items],
+            [sk.scalar for sk, _msg in items],
+        )
+        return futures.submit(
+            lambda: [th.SignatureShare(p) for p in fin()],
+            "sign_share_batch",
+        )
+
+    def submit_rs_encode_batch(
+        self, data, data_shards: int, parity_shards: int
+    ) -> "futures.CryptoFuture":
+        from . import futures
+
+        from ..ops import rs_jax
+
+        out = rs_jax.rs_encode_batch(data, data_shards, parity_shards)
+        return futures.submit(
+            lambda: np.asarray(out), "rs_encode_batch"
+        )
+
+    def submit_rs_reconstruct_batch(
+        self, surviving, rows, data_shards: int, parity_shards: int
+    ) -> "futures.CryptoFuture":
+        from . import futures
+
+        from ..ops import rs_jax
+
+        out = rs_jax.rs_reconstruct_batch(
+            surviving, tuple(int(r) for r in rows), data_shards, parity_shards
+        )
+        return futures.submit(
+            lambda: np.asarray(out), "rs_reconstruct_batch"
+        )
 
     def combine_signature_shares_batch(
         self,

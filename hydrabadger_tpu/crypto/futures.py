@@ -1,0 +1,367 @@
+"""CryptoFuture — the asynchronous dispatch plane of the CryptoEngine.
+
+The round-6 batching collapsed the era-switch walls into a handful of
+big device dispatches, but every one of them was SYNCHRONOUS: the host
+submitted a batch and then sat in ``limbs_to_points``/``np.asarray``
+until the device finished, even though JAX dispatch is already async —
+the eager host materialization is what threw the overlap away.  This
+module is the thin contract that keeps it:
+
+* ``CryptoFuture`` wraps a deferred host materialization.  ``submit``
+  runs the device dispatch NOW (enqueue-and-return under JAX's async
+  dispatch) and defers only the host conversion; ``immediate`` wraps an
+  already-computed value (the CPU engine's futures, so sans-io cores
+  and tests stay engine-agnostic).
+* ``result()`` materializes exactly once and caches — the protocol
+  effect a result drives must happen exactly once, so the plane
+  guarantees the underlying fetch does too.
+* A future dropped without ``result()`` is device work silently thrown
+  away AND, worse, a protocol effect (an ack batch, a verification
+  verdict) that never happened.  ``__del__`` makes that LOUD: an ERROR
+  log, the ``crypto_futures_dropped`` counter, and a remembered label
+  that :func:`check_dropped` re-raises for tests/harnesses.
+
+Overlap accounting (the tentpole's honesty surface): every future
+stamps the process registry (``obs.metrics.default_registry``) at its
+submit/fetch boundaries —
+
+* ``device_overlap_ratio`` — of the wall time between submit and the
+  first ``result()`` call, the fraction the host spent doing OTHER work
+  (overlap) rather than blocked inside the materializer.  1.0 means the
+  device finished entirely in the host's shadow; 0.0 means the plane
+  degenerated to the old synchronous dispatch.
+* ``device_idle_s`` — cumulative wall time with NO future in flight
+  between one fetch completing and the next submit: the gap a deeper
+  pipeline (more polls in flight) could still fill.
+
+Ordering: completion order on the device is NOT protocol order.
+Consumers must apply effects in SUBMISSION order — ``settle_in_order``
+is the one sanctioned drain loop (tests/test_futures.py pins that an
+adversarial completion order cannot reorder effects through it).
+
+The plane is gated by ``HYDRABADGER_ASYNC`` ("0" disables deferral —
+consumers then settle at the submission site, bit-identical to the
+synchronous path; the tier-1 identity test runs a full era both ways).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..obs.logging import get_logger
+
+log = get_logger("hydrabadger.futures")
+
+# -- plane gate --------------------------------------------------------------
+
+
+def enabled() -> bool:
+    """Is cross-poll deferral on?  The futures OBJECTS always work;
+    this gates whether consumers hold them in flight across host work
+    (the overlap architecture) or settle at the submission site."""
+    return os.environ.get("HYDRABADGER_ASYNC", "1") != "0"
+
+
+# -- overlap / idle accounting ----------------------------------------------
+
+_inflight = 0
+_overlap_s = 0.0  # submit -> first result() call, host elsewhere
+_block_s = 0.0  # host blocked inside the materializer
+_idle_since: Optional[float] = None  # set when the last inflight fetches
+_idle_s = 0.0
+_dropped: List[str] = []  # labels of futures dropped unmaterialized
+
+
+def _registry():
+    from ..obs.metrics import default_registry
+
+    return default_registry()
+
+
+def _note_submit(now: float) -> None:
+    global _inflight, _idle_s, _idle_since
+    if _inflight == 0 and _idle_since is not None:
+        _idle_s += now - _idle_since
+        _idle_since = None
+    _inflight += 1
+    _registry().counter("crypto_futures_submitted").inc()
+
+
+def _note_fetch(overlap: float, block: float, now: float) -> None:
+    global _inflight, _overlap_s, _block_s, _idle_since
+    _inflight = max(0, _inflight - 1)
+    if _inflight == 0:
+        _idle_since = now
+    _overlap_s += overlap
+    _block_s += block
+    reg = _registry()
+    reg.counter("crypto_futures_fetched").inc()
+    stamp_gauges(reg)
+
+
+def _note_drop(now: float) -> None:
+    """A dropped future still leaves the in-flight set — without this
+    the idle clock would freeze process-wide after one drop."""
+    global _inflight, _idle_since
+    _inflight = max(0, _inflight - 1)
+    if _inflight == 0:
+        _idle_since = now
+
+
+def stamp_gauges(reg=None) -> None:
+    """Write the cumulative overlap/idle gauges into ``reg`` (default:
+    the process registry) — called at every fetch boundary and by the
+    sim/bench drains that surface the numbers in their rows."""
+    from ..obs.metrics import DEVICE_IDLE_S, DEVICE_OVERLAP_RATIO
+
+    reg = reg if reg is not None else _registry()
+    total = _overlap_s + _block_s
+    reg.gauge(DEVICE_OVERLAP_RATIO).set(
+        round(_overlap_s / total, 4) if total else 0.0
+    )
+    reg.gauge(DEVICE_IDLE_S).set(round(_idle_s, 4))
+
+
+def overlap_snapshot() -> dict:
+    """The plane's cumulative accounting as one JSON-able dict."""
+    total = _overlap_s + _block_s
+    return {
+        "device_overlap_ratio": round(_overlap_s / total, 4) if total else 0.0,
+        "device_overlap_s": round(_overlap_s, 4),
+        "device_block_s": round(_block_s, 4),
+        "device_idle_s": round(_idle_s, 4),
+        "futures_dropped": len(_dropped),
+    }
+
+
+def reset_accounting() -> None:
+    """Zero the cumulative counters (bench rows that want per-run
+    ratios snapshot-and-reset around their timed region).  Resets the
+    in-flight count too: callers scope this at run boundaries where
+    nothing is legitimately in flight."""
+    global _overlap_s, _block_s, _idle_s, _idle_since, _inflight
+    _overlap_s = _block_s = _idle_s = 0.0
+    _idle_since = None
+    _inflight = 0
+    _dropped.clear()
+
+
+def check_dropped() -> None:
+    """Raise if any future was dropped unmaterialized since the last
+    reset — the loud surface for tests and harness teardowns (the
+    ``__del__`` path already logged and counted each one)."""
+    if _dropped:
+        labels, count = list(_dropped), len(_dropped)
+        _dropped.clear()
+        raise RuntimeError(
+            f"{count} CryptoFuture(s) dropped without result(): "
+            f"{labels[:8]} — device work and its protocol effect were "
+            "silently discarded"
+        )
+
+
+# -- the future itself -------------------------------------------------------
+
+
+class CryptoFuture:
+    """A deferred host materialization of one submitted device batch.
+
+    ``result()`` is idempotent (cached) but the MATERIALIZER runs
+    exactly once; dropping an unmaterialized future is loud (ERROR log
+    + ``crypto_futures_dropped`` + :func:`check_dropped`)."""
+
+    __slots__ = ("_fn", "_value", "_exc", "_done", "label", "_submit_t")
+
+    def __init__(self, fn: Callable[[], Any], label: str = "crypto"):
+        self._fn: Optional[Callable[[], Any]] = fn
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+        self._done = False
+        self.label = label
+        self._submit_t = time.perf_counter()
+        _note_submit(self._submit_t)
+
+    @classmethod
+    def done_value(cls, value: Any, label: str) -> "CryptoFuture":
+        """An already-materialized future (the CPU engine's submit_*).
+
+        Deliberately OUTSIDE the overlap accounting: the work ran
+        synchronously at the submission site, so counting the long
+        submit→result gap as "overlap" would report a perfect ratio on
+        a run with no deferred device work at all.  A pure-host run
+        therefore reads device_overlap_ratio = 0.0 — honest: nothing
+        overlapped, because nothing was deferred."""
+        fut = cls.__new__(cls)
+        fut._fn = None
+        fut._value = value
+        fut._exc = None
+        fut._done = True
+        fut.label = label
+        fut._submit_t = time.perf_counter()
+        reg = _registry()
+        reg.counter("crypto_futures_submitted").inc()
+        reg.counter("crypto_futures_fetched").inc()
+        return fut
+
+    @property
+    def done(self) -> bool:
+        """Has the host materialization run?  (Device-side completion
+        is invisible by design — JAX owns that queue.)"""
+        return self._done
+
+    def result(self) -> Any:
+        if not self._done:
+            fn, self._fn = self._fn, None
+            t0 = time.perf_counter()
+            try:
+                self._value = fn()  # type: ignore[misc]
+            except BaseException as e:
+                # cache the failure: a retry must re-raise the original
+                # error, not silently hand back None
+                self._exc = e
+                raise
+            finally:
+                now = time.perf_counter()
+                self._done = True
+                _note_fetch(t0 - self._submit_t, now - t0, now)
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def __del__(self):  # pragma: no cover - exercised via gc in tests
+        if not self._done:
+            # loud on every surface reachable from a destructor: log,
+            # counter, and the check_dropped raise-later list.  The
+            # discarded work is gone either way — silence is the bug.
+            try:
+                _note_drop(time.perf_counter())
+                _dropped.append(self.label)
+                _registry().counter("crypto_futures_dropped").inc()
+                log.error(
+                    "CryptoFuture %r dropped without result(): device "
+                    "work and its protocol effect were discarded",
+                    self.label,
+                )
+            except Exception:
+                pass  # interpreter teardown: the module may be gone
+
+    def __repr__(self) -> str:
+        state = "done" if self._done else "pending"
+        return f"<CryptoFuture {self.label} {state}>"
+
+
+def immediate(value: Any, label: str = "immediate") -> CryptoFuture:
+    """A future that already holds its value — the CPU engine's
+    ``submit_*`` return type, so consumers never branch on engine.
+    Excluded from overlap accounting (see CryptoFuture.done_value)."""
+    return CryptoFuture.done_value(value, label)
+
+
+def submit(fn: Callable[[], Any], label: str = "crypto") -> CryptoFuture:
+    """Wrap a deferred materializer.  ``fn`` must capture an ALREADY
+    DISPATCHED device computation (submit-then-defer) — wrapping the
+    dispatch itself would just move the synchronous wall into
+    ``result()``."""
+    return CryptoFuture(fn, label)
+
+
+def settle_in_order(
+    futures: Sequence[CryptoFuture],
+    apply: Callable[[int, Any], None],
+) -> None:
+    """Drain ``futures`` applying effects in SUBMISSION order.
+
+    Device/backend completion order is not protocol order: a fake or
+    real engine completing batch 2 before batch 1 must not let batch
+    2's effects (acks, verdicts) land first.  This is the one
+    sanctioned drain loop; ``apply(i, value)`` runs strictly at
+    ascending ``i``."""
+    for i, fut in enumerate(futures):
+        apply(i, fut.result())
+
+
+# -- cross-node tick coalescing ---------------------------------------------
+
+
+class MsmCoalescer:
+    """Per-tick MSM coalescing for in-process multi-node runtimes.
+
+    The sim runs every node in one process, so within one router tick
+    N nodes each submit their own small MSM batch.  With the coalescer
+    on (``HYDRABADGER_COALESCE=1`` — the sim's dhb runs scope it), a
+    submission only QUEUES its jobs; the first ``result()`` of any
+    queued future — in practice the tick-boundary drain — flushes the
+    whole queue as ONE ops/msm_T dispatch and scatters the per-job
+    points back to each submission's slot.  Results are bit-identical
+    to per-node dispatches (jobs are independent lanes; padding lanes
+    are ladder identities), so this changes dispatch count, never
+    values."""
+
+    def __init__(self):
+        self._pending: List[tuple] = []  # (jobs, fallback, slot)
+
+    @property
+    def depth(self) -> int:
+        return len(self._pending)
+
+    def submit(
+        self,
+        jobs: Sequence,
+        fallback: Callable[[], list],
+        label: str = "msm-coalesced",
+    ) -> CryptoFuture:
+        slot: dict = {}
+        self._pending.append((list(jobs), fallback, slot))
+        _registry().counter("msm_coalesce_submissions").inc()
+
+        def _materialize():
+            if "value" not in slot and "error" not in slot:
+                self._flush()
+            if "error" in slot:
+                raise slot["error"]
+            return slot["value"]
+
+        return CryptoFuture(_materialize, label)
+
+    def _flush(self) -> None:
+        batch, self._pending = self._pending, []
+        if not batch:
+            return
+        all_jobs = [j for jobs, _fb, _slot in batch for j in jobs]
+        _registry().counter("msm_coalesce_flushes").inc()
+        _registry().gauge("msm_coalesce_width").track(len(batch))
+        try:
+            from ..ops import msm_T
+
+            results = msm_T.g1_msm_batch_submit(all_jobs)()
+        except Exception:
+            # per-submission fallback on ANY combined-dispatch failure —
+            # including a structural ValueError: one submission's
+            # malformed job must not leave its SIBLINGS' slots unfilled
+            # (their result() would die on the wrong error).  The
+            # malformed submission stays loud AND attributed: its own
+            # fallback's error is stored in ITS slot and re-raised at
+            # ITS result(); innocents get their host results.
+            for _jobs, fb, slot in batch:
+                try:
+                    slot["value"] = fb()
+                except Exception as fe:  # noqa: BLE001 - per-slot verdict
+                    slot["error"] = fe
+            return
+        i = 0
+        for jobs, _fb, slot in batch:
+            slot["value"] = results[i : i + len(jobs)]
+            i += len(jobs)
+
+
+_MSM_COALESCER = MsmCoalescer()
+
+
+def msm_coalescer() -> Optional[MsmCoalescer]:
+    """The process coalescer when coalescing is scoped on, else None.
+    (A future created while the scope was on still flushes correctly
+    after it turns off — the closure holds the coalescer itself.)"""
+    if os.environ.get("HYDRABADGER_COALESCE", "0") == "1":
+        return _MSM_COALESCER
+    return None

@@ -16,6 +16,12 @@ state.rs:276-299; SURVEY.md §2.2):
 Everything takes explicit rng / deterministic inputs — the framework
 threads randomness, never pulls ambient entropy inside protocol code
 (SURVEY.md §7 hard part 4).
+
+hbasync note: this module is inside the ``eager-fetch`` lint scope —
+code here consuming a CryptoEngine ``submit_*`` result must fetch it
+through ``.result()`` at a fetch point registered in
+``lint/registry.py:ASYNC_FETCH_POINTS`` (see crypto/futures.py for the
+plane's contract), never materialize it at the submission site.
 """
 from __future__ import annotations
 

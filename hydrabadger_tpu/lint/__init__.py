@@ -142,8 +142,9 @@ def _suppressions(sf: SourceFile) -> Tuple[Dict[int, Dict[str, str]], List[Findi
 
 def all_rules():
     """The rule registry, in report order."""
-    from . import deadcode, jit_hygiene, limb_layout, mosaic, sansio
-    from . import retrace_budget, secrets, taint, wire_contract
+    from . import async_fetch, deadcode, jit_hygiene, limb_layout
+    from . import mosaic, retrace_budget, sansio, secrets, taint
+    from . import wire_contract
 
     return [
         sansio,
@@ -151,6 +152,7 @@ def all_rules():
         jit_hygiene,
         limb_layout,
         wire_contract,
+        async_fetch,
         taint,
         secrets,
         retrace_budget,

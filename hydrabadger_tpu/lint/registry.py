@@ -171,6 +171,29 @@ OBS_EMIT_NAMES = frozenset({"recorder", "rec", "_rec"})
 OBS_EMIT_SUFFIX = "obs"
 
 # --------------------------------------------------------------------------
+# async dispatch (hbasync) — eager-fetch rule (lint/async_fetch.py)
+# --------------------------------------------------------------------------
+
+# Registered fetch points: "relpath::function" -> why a submit_* result
+# may materialize there.  Everywhere else in the rule's scope
+# (crypto/dkg.py, crypto/threshold.py, consensus/), calling .result()
+# on — or np.asarray/list()/.item()-ing — a submit_* result is a
+# finding: eager materialization re-synchronizes the dispatch and
+# silently throws the overlap architecture away.
+ASYNC_FETCH_POINTS = {
+    "crypto/dkg.py::g1_msm_batch": (
+        "the synchronous spelling: submit + immediate fetch, for callers "
+        "outside the overlap plane"
+    ),
+    "crypto/dkg.py::settle": (
+        "the settle closures of handle_parts_submit / "
+        "_verify_values_batch_submit — THE designed fetch boundary; "
+        "callers hold them across host work and invoke in submission "
+        "order"
+    ),
+}
+
+# --------------------------------------------------------------------------
 # retrace-budget
 # --------------------------------------------------------------------------
 

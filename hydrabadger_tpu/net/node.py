@@ -173,10 +173,24 @@ class KeyGenMachine:
         """A poll's worth of parts in one call: the underlying
         SyncKeyGen batches every row RLC check into a single MSM and
         seals the resulting ack values in one pass (round 6)."""
-        outcomes = self.kg.handle_parts(items)
-        if any(o.valid or o.recorded for o in outcomes):
-            self._drain_pending_acks()
-        return outcomes
+        return self.handle_parts_submit(items)()
+
+    def handle_parts_submit(self, items: List[tuple]):
+        """Submit a poll's parts (hbasync): the row-RLC MSM dispatches
+        now; the returned settle fetches the verdicts, replays any
+        acks that raced ahead of their parts, and returns the
+        outcomes.  The node's poll flush holds the settle across the
+        NEXT poll's submit — the double buffer that keeps the device
+        busy through the DKG storm."""
+        settle_kg = self.kg.handle_parts_submit(items)
+
+        def settle() -> List:
+            outcomes = settle_kg()
+            if any(o.valid or o.recorded for o in outcomes):
+                self._drain_pending_acks()
+            return outcomes
+
+        return settle
 
     def handle_ack(self, sender, ack: Ack):
         if ack.proposer_idx not in self.kg.parts:
@@ -271,6 +285,12 @@ class Hydrabadger:
         # the handler loop drains one 50-msg poll — every part in the
         # poll settles its row RLC check in ONE batched MSM at flush
         self._kg_poll: Optional[List[tuple]] = None
+        # hbasync double buffer: the PREVIOUS poll's submitted part
+        # flushes, their MSMs still in flight — settled after the next
+        # poll's submit (overlap) or immediately when the handler queue
+        # is empty (no next poll imminent: deferring would stall the
+        # DKG).  Entries: (machine, instance_id, items, settle).
+        self._kg_prev: List[tuple] = []
         self.iom_queue: List[tuple] = []  # messages before DHB exists
         self.batch_queue: asyncio.Queue = asyncio.Queue()
         self.batches: List[DhbBatch] = []
@@ -445,6 +465,11 @@ class Hydrabadger:
 
     async def stop(self) -> None:
         self._stopped.set()
+        # settle any in-flight keygen flushes: device work must never be
+        # silently discarded (crypto/futures drop detection is loud)
+        prev, self._kg_prev = self._kg_prev, []
+        for entry in prev:
+            self._settle_kg_flush(entry)
         if self._server is not None:
             self._server.close()
         self.peers.close_all()
@@ -1089,40 +1114,81 @@ class Hydrabadger:
             )
 
     def _flush_kg_poll(self) -> None:
-        """Settle the poll's deferred keygen parts per machine: one
+        """Flush the poll's deferred keygen parts per machine: one
         SyncKeyGen.handle_parts call batches every row RLC check into a
         single MSM and seals all resulting ack values through the
-        batched channel plane."""
+        batched channel plane.
+
+        Double-buffered (hbasync): this poll's MSMs are SUBMITTED
+        first, then the PREVIOUS poll's flushes — their device work
+        having overlapped an entire handler poll of host work — settle
+        and emit their acks.  When the handler queue is empty the new
+        submissions settle immediately too: with no next poll imminent,
+        holding them would stall the DKG (peers wait on our acks)."""
         buf = self._kg_poll
-        if not buf:
-            return
-        grouped: Dict[int, tuple] = {}
-        for machine, instance_id, src, part in buf:
-            grouped.setdefault(id(machine), (machine, instance_id, []))[
-                2
-            ].append((src, part))
-        for machine, instance_id, items in grouped.values():
-            try:
-                outcomes = machine.handle_parts(items)
-            except Exception:
-                log.exception("keygen poll batch failed")
-                continue
-            for (src, _part), outcome in zip(items, outcomes):
-                # per-item guard, the old inline path's granularity: an
-                # emission error (e.g. a dying transport) must not
-                # abandon the REMAINING acks — a replayed part hits the
-                # duplicate path (ack=None), so a dropped ack would
-                # never regenerate
+        from ..crypto import futures as _futures
+
+        submitted: List[tuple] = []
+        if buf:
+            grouped: Dict[int, tuple] = {}
+            for machine, instance_id, src, part in buf:
+                grouped.setdefault(id(machine), (machine, instance_id, []))[
+                    2
+                ].append((src, part))
+            use_async = _futures.enabled()
+            for machine, instance_id, items in grouped.values():
                 try:
-                    self._emit_part_outcome(machine, instance_id, src, outcome)
+                    if use_async:
+                        settle = machine.handle_parts_submit(items)
+                    else:
+                        outcomes = machine.handle_parts(items)
+                        settle = lambda _o=outcomes: _o  # noqa: E731
                 except Exception:
-                    log.exception(
-                        "keygen ack emit failed for %s", src.hex()[:8]
-                    )
-            self._maybe_finish_keygen(machine)
+                    log.exception("keygen poll batch failed")
+                    continue
+                submitted.append((machine, instance_id, items, settle))
+        # settle the previous poll's in-flight flushes AFTER submitting
+        # this poll's — submission order is effect order either way
+        prev, self._kg_prev = self._kg_prev, []
+        for entry in prev:
+            self._settle_kg_flush(entry)
+        if submitted and _futures.enabled() and not self._internal.empty():
+            # more traffic already queued: hold this poll's flushes in
+            # flight across the next poll's host work
+            self._kg_prev = submitted
+        else:
+            for entry in submitted:
+                self._settle_kg_flush(entry)
+
+    def _settle_kg_flush(self, entry: tuple) -> None:
+        """Fetch one submitted flush's verdicts and emit its acks."""
+        machine, instance_id, items, settle = entry
+        try:
+            outcomes = settle()
+        except Exception:
+            log.exception("keygen poll batch failed")
+            return
+        for (src, _part), outcome in zip(items, outcomes):
+            # per-item guard, the old inline path's granularity: an
+            # emission error (e.g. a dying transport) must not
+            # abandon the REMAINING acks — a replayed part hits the
+            # duplicate path (ack=None), so a dropped ack would
+            # never regenerate
+            try:
+                self._emit_part_outcome(machine, instance_id, src, outcome)
+            except Exception:
+                log.exception(
+                    "keygen ack emit failed for %s", src.hex()[:8]
+                )
+        self._maybe_finish_keygen(machine)
 
     def _maybe_finish_keygen(self, machine: KeyGenMachine) -> None:
         if machine is None or not machine.is_complete():
+            return
+        if machine.state == "complete":
+            # already generated: with hbasync a deferred poll-flush
+            # settle can revisit a machine an inline ack completed —
+            # re-generating would rebuild self.dhb and wipe its history
             return
         pk_set, sk_share = machine.generate()
         if machine.instance_id == ("builtin",):

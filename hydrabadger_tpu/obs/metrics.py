@@ -17,6 +17,21 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+# hbasync overlap gauges, stamped by crypto/futures at every submit /
+# fetch boundary.  The names are fixed HERE so every surface that reads
+# them — the bench config-5 row, SOAK.json rows, the sim registry the
+# tick drain mirrors them into — binds to one spelling:
+#
+#   DEVICE_OVERLAP_RATIO — of the wall between a submit and its fetch,
+#       the fraction the host spent on other work instead of blocked in
+#       the materializer (1.0 = device fully hidden; 0.0 = the plane
+#       degenerated to synchronous dispatch — a regression tripwire).
+#   DEVICE_IDLE_S — cumulative wall with nothing in flight between one
+#       fetch completing and the next submit: pipeline headroom.
+DEVICE_OVERLAP_RATIO = "device_overlap_ratio"
+DEVICE_IDLE_S = "device_idle_s"
+
+
 class Counter:
     __slots__ = ("value",)
 

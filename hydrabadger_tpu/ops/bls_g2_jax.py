@@ -298,11 +298,9 @@ def _fq2_batch_inverse(els: Sequence) -> list:
 # ---------------------------------------------------------------------------
 
 
-def g2_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
-    """Batched sk * H(m) over G2: signature-share generation for a whole
-    batch of (node, epoch) coin rounds at once.  Lane count bucketed
-    with identity padding (bls_jax._pad_mul_batch) so coin polls of
-    varying size share compiled ladder shapes."""
+def g2_scalar_mul_batch_submit(points: Sequence, scalars: Sequence[int]):
+    """Dispatch the batched G2 ladder now, defer the host affine
+    conversion: returns a zero-arg finisher (see crypto/futures)."""
     from .bls_jax import _pad_mul_batch
 
     points, scalars, n = _pad_mul_batch(
@@ -310,7 +308,16 @@ def g2_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
     )
     pts = jnp.asarray(g2_points_to_limbs(points))
     wins = jnp.asarray(scalars_to_windows([s % bls.R for s in scalars]))
-    return limbs_to_g2_points(g2_scalar_mul_windowed(pts, wins))[:n]
+    out = g2_scalar_mul_windowed(pts, wins)
+    return lambda: limbs_to_g2_points(out)[:n]
+
+
+def g2_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
+    """Batched sk * H(m) over G2: signature-share generation for a whole
+    batch of (node, epoch) coin rounds at once.  Lane count bucketed
+    with identity padding (bls_jax._pad_mul_batch) so coin polls of
+    varying size share compiled ladder shapes."""
+    return g2_scalar_mul_batch_submit(points, scalars)()
 
 
 def g2_weighted_sum_batch(

@@ -822,17 +822,23 @@ def _pad_mul_batch(points: Sequence, scalars: Sequence[int], inf):
     return points, scalars, n
 
 
+def g1_scalar_mul_batch_submit(points: Sequence, scalars: Sequence[int]):
+    """Dispatch the batched G1 ladder now, defer the host affine
+    conversion: returns a zero-arg finisher (the engine wraps it in a
+    CryptoFuture — crypto/futures)."""
+    points, scalars, n = _pad_mul_batch(points, scalars, bls.infinity(FQ))
+    pts = jnp.asarray(points_to_limbs(points))
+    w1, w2 = scalars_to_glv_windows(scalars)
+    out = jac_scalar_mul_glv(pts, jnp.asarray(w1), jnp.asarray(w2))
+    return lambda: limbs_to_points(out)[:n]
+
+
 def g1_scalar_mul_batch(points: Sequence, scalars: Sequence[int]) -> list:
     """Batched U*sk over G1: len(points) == len(scalars) CPU points in,
     CPU points out.  This is decrypt-share generation for a whole batch
     of (instance, node) pairs at once.  The lane count is bucketed
     (identity padding) so the compiled-ladder cache stays small."""
-    points, scalars, n = _pad_mul_batch(points, scalars, bls.infinity(FQ))
-    pts = jnp.asarray(points_to_limbs(points))
-    w1, w2 = scalars_to_glv_windows(scalars)
-    return limbs_to_points(
-        jac_scalar_mul_glv(pts, jnp.asarray(w1), jnp.asarray(w2))
-    )[:n]
+    return g1_scalar_mul_batch_submit(points, scalars)()
 
 
 def g1_weighted_sum_batch(

@@ -205,18 +205,20 @@ def _pack_jobs(
     return limbs, flat_ks, b, s
 
 
-def g1_msm_batch(
+def g1_msm_batch_submit(
     jobs: Sequence[Tuple[Sequence, Sequence[int]]]
-) -> List:
-    """Evaluate B independent MSMs Σ_i ks[i]·pts[i] in one dispatch.
+):
+    """Dispatch B independent MSMs and DEFER the host materialization.
 
-    `jobs`: sequence of (points, scalars) pairs — CPU projective point
-    tuples and Python ints; jobs may be ragged (padded internally).
-    Returns one combined CPU point per job, bit-identical to
-    crypto/dkg.g1_msm_or_fallback per job.
-    """
+    Runs packing and the device dispatch now (JAX dispatch is async:
+    the call returns with the program enqueued) and returns a zero-arg
+    finisher whose call performs the one remaining host step — the
+    batched Jacobian->affine conversion (`limbs_to_points`).  The
+    engine's `submit_g1_msm_batch` wraps the finisher in a
+    CryptoFuture; `g1_msm_batch` below is the synchronous spelling
+    (dispatch + immediate finish)."""
     if not jobs:
-        return []
+        return lambda: []
     from ..obs import retrace as _retrace
     from ..obs.metrics import default_registry as _reg
 
@@ -251,4 +253,17 @@ def g1_msm_batch(
             jnp.asarray(w1.reshape(b, s, -1)),
             jnp.asarray(w2.reshape(b, s, -1)),
         )
-    return limbs_to_points(out)[:n_jobs]
+    return lambda: limbs_to_points(out)[:n_jobs]
+
+
+def g1_msm_batch(
+    jobs: Sequence[Tuple[Sequence, Sequence[int]]]
+) -> List:
+    """Evaluate B independent MSMs Σ_i ks[i]·pts[i] in one dispatch.
+
+    `jobs`: sequence of (points, scalars) pairs — CPU projective point
+    tuples and Python ints; jobs may be ragged (padded internally).
+    Returns one combined CPU point per job, bit-identical to
+    crypto/dkg.g1_msm_or_fallback per job.
+    """
+    return g1_msm_batch_submit(jobs)()

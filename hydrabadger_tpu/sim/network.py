@@ -62,6 +62,17 @@ class SimConfig:
     # bench/test toggling the plane cannot leak it process-wide into
     # later configs (ADVICE r5 / the bench.py:328 leak)
     tpu_dkg: Optional[bool] = None
+    # hbasync futures plane (crypto/futures HYDRABADGER_ASYNC): None =
+    # inherit; True/False = force cross-poll deferral on/off for each
+    # run_epoch (scoped+restored like tpu_dkg).  The tier-1 identity
+    # test runs a full era both ways and asserts identical committed
+    # batches and DKG outputs.
+    async_dispatch: Optional[bool] = None
+    # per-tick MSM coalescing (crypto/futures.MsmCoalescer): None =
+    # on — the in-process sim IS the designed scope (all nodes' era-
+    # switch MSMs flush as one device dispatch per tick); False forces
+    # per-node dispatches, True forces coalescing even off-sim-default.
+    coalesce: Optional[bool] = None
     # hbtrace: record consensus spans (RBC/BA/subset/tdec/epoch) into
     # SimNetwork.recorder; the router stamps them at each delivery.
     # Off by default — the null recorder keeps the hooks ~free.
@@ -69,20 +80,27 @@ class SimConfig:
 
 
 @contextmanager
-def _dkg_plane(flag: Optional[bool]):
-    """Scoped HYDRABADGER_TPU_DKG override (see SimConfig.tpu_dkg)."""
+def _env_flag(name: str, flag: Optional[bool]):
+    """Scoped boolean env override, restored on exit (the tpu_dkg /
+    async_dispatch discipline: a bench or test forcing a plane must
+    not leak it process-wide into later configs)."""
     if flag is None:
         yield
         return
-    prev = os.environ.get("HYDRABADGER_TPU_DKG")
-    os.environ["HYDRABADGER_TPU_DKG"] = "1" if flag else "0"
+    prev = os.environ.get(name)
+    os.environ[name] = "1" if flag else "0"
     try:
         yield
     finally:
         if prev is None:
-            os.environ.pop("HYDRABADGER_TPU_DKG", None)
+            os.environ.pop(name, None)
         else:
-            os.environ["HYDRABADGER_TPU_DKG"] = prev
+            os.environ[name] = prev
+
+
+def _dkg_plane(flag: Optional[bool]):
+    """Scoped HYDRABADGER_TPU_DKG override (see SimConfig.tpu_dkg)."""
+    return _env_flag("HYDRABADGER_TPU_DKG", flag)
 
 
 @dataclass
@@ -203,6 +221,10 @@ class SimNetwork:
             recorder=self.recorder,
             metrics=self.metrics,
         )
+        # hbasync tick boundary: the router settles in-flight device
+        # work at each quiescence, so completions submitted during a
+        # tick drain before the next tick's proposals
+        self.router.drain_hook = self._drain_async
         self._txn_counter = 0
         self.total_wall_s = 0.0  # cumulative across run() calls / resumes
         self.epoch_durations: List[float] = []  # seconds, per run_epoch
@@ -215,6 +237,8 @@ class SimNetwork:
         self.__dict__.setdefault("epoch_durations", [])
         self.__dict__.setdefault("recorder", NULL_RECORDER)
         self.__dict__.setdefault("metrics", MetricsRegistry())
+        if getattr(self.router, "drain_hook", None) is None:
+            self.router.drain_hook = self._drain_async
 
     def _handle(self, me, sender, message):
         return self.nodes[me].handle_message(sender, message)
@@ -314,13 +338,33 @@ class SimNetwork:
         """Generate workload, propose everywhere, run to quiescence."""
         # getattr: SimConfig instances unpickled from pre-round-6
         # checkpoints predate the field (see __setstate__)
-        with _dkg_plane(getattr(self.cfg, "tpu_dkg", None)):
+        coalesce = getattr(self.cfg, "coalesce", None)
+        with _dkg_plane(getattr(self.cfg, "tpu_dkg", None)), _env_flag(
+            "HYDRABADGER_ASYNC", getattr(self.cfg, "async_dispatch", None)
+        ), _env_flag(
+            "HYDRABADGER_COALESCE", True if coalesce is None else coalesce
+        ):
             self._run_epoch_inner()
+            self._drain_async()
         # events emitted outside a router delivery (propose calls, the
         # native-ACS batch application) are still pending: the epoch
         # boundary is the sim's other I/O boundary
         if self.recorder.enabled:
             self.recorder.stamp(time.perf_counter())
+
+    def _drain_async(self) -> None:
+        """Tick-boundary drain of the hbasync plane: settle every
+        node's in-flight crypto (completions submitted during this
+        epoch drain before the next one proposes) and surface the
+        overlap gauges in THIS sim's registry so soak/bench rows carry
+        them."""
+        for nid in self.ids:
+            drain = getattr(self.nodes[nid], "drain_async", None)
+            if drain is not None:
+                self.router.dispatch_step(nid, drain())
+        from ..crypto import futures as _futures
+
+        _futures.stamp_gauges(self.metrics)
 
     def _run_epoch_inner(self) -> None:
         t0 = time.perf_counter()

@@ -60,6 +60,12 @@ class Router:
         self.outputs: Dict[Any, List[Any]] = {nid: [] for nid in self.node_ids}
         self.faults: List[Tuple[Any, Any]] = []
         self.delivered = 0
+        # hbasync: called once at each true quiescence, BEFORE run()
+        # returns — the tick boundary where the owning network settles
+        # the nodes' in-flight device work (drain completions next
+        # tick).  A drain may enqueue follow-up traffic; the run loop
+        # re-enters delivery if it did.
+        self.drain_hook: Optional[Callable[[], None]] = None
 
     def __setstate__(self, state):
         """Unpickle (checkpoint resume): obs fields postdate older
@@ -67,6 +73,7 @@ class Router:
         self.__dict__.update(state)
         self.__dict__.setdefault("obs", _resolve_recorder(None))
         self.__dict__.setdefault("metrics", None)
+        self.__dict__.setdefault("drain_hook", None)
 
     def dispatch_step(self, sender, step: Step) -> None:
         """Queue a step's messages; record its outputs/faults."""
@@ -140,7 +147,15 @@ class Router:
             # quiescence: delays model reordering, not permanent loss
             flush = getattr(self.adversary, "flush", None)
             released = flush() if flush is not None else None
-            if not released:
-                return count
-            for sender, recipient, message in released:
-                self.queue.append((sender, recipient, message))
+            if released:
+                for sender, recipient, message in released:
+                    self.queue.append((sender, recipient, message))
+                continue
+            if self.drain_hook is not None:
+                # settle in-flight device work at the tick boundary; a
+                # second pass is a no-op (nothing left in flight), so
+                # this cannot livelock the quiescence loop
+                self.drain_hook()
+                if self.queue:
+                    continue
+            return count

@@ -92,6 +92,12 @@ def sim_soak(epochs: int = 1000, n_nodes: int = 16,
     # has no gauge, so the per-chunk max above folds in
     peaks = dict(net.queue_peaks())
     peaks["deferred"] = max(peaks["deferred"], max_deferred)
+    # hbasync overlap accounting, surfaced as first-class row fields so
+    # an overlap regression shows up in the SOAK.json trajectory without
+    # digging through the metrics blob
+    from ..crypto import futures as _futures
+
+    overlap = _futures.overlap_snapshot()
     return {
         "tier": "sim_native_acs",
         "epochs": committed,
@@ -101,6 +107,8 @@ def sim_soak(epochs: int = 1000, n_nodes: int = 16,
         "rss_growth_mb": round(rss1 - rss0, 1),
         "max_deferred": max_deferred,
         "queue_peaks": peaks,
+        "device_overlap_ratio": overlap["device_overlap_ratio"],
+        "device_idle_s": overlap["device_idle_s"],
         "metrics": net.metrics.snapshot(),
         "agreement_ok": m.agreement_ok,
     }
@@ -183,6 +191,9 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
         assert peaks["deferred"] <= 1000, peaks
         assert peaks["future"] <= 1000, peaks
         assert peaks["retry"] <= 4096, peaks
+        from ..crypto import futures as _futures
+
+        overlap = _futures.overlap_snapshot()
         return {
             "tier": "tcp_4node_full_crypto",
             "epochs": epochs_done,
@@ -191,6 +202,8 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
             "rss_end_mb": round(rss1, 1),
             "rss_growth_mb": round(rss1 - rss0, 1),
             "queue_peaks": peaks,
+            "device_overlap_ratio": overlap["device_overlap_ratio"],
+            "device_idle_s": overlap["device_idle_s"],
             "metrics": merged,
         }
 

@@ -42,6 +42,7 @@
 #include <vector>
 
 #if defined(__x86_64__)
+#include <cpuid.h>
 #include <immintrin.h>
 #endif
 
@@ -109,7 +110,14 @@ void sha256_block_ni(uint32_t h[8], const uint8_t* p) {
 }
 
 bool have_sha_ni() {
-  static const bool ok = __builtin_cpu_supports("sha");
+  // raw CPUID leaf 7 EBX bit 29: __builtin_cpu_supports("sha") only
+  // learned the "sha" feature string in gcc 11, and this must build on
+  // older toolchains too
+  static const bool ok = [] {
+    unsigned int eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    return ((ebx >> 29) & 1u) != 0u;
+  }();
   return ok;
 }
 #endif

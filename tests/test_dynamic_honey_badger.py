@@ -277,6 +277,11 @@ def test_byzantine_ack_cannot_split_era_switch_gate():
         d = dhbs[byz]
         if d.key_gen is None:
             return
+        # hbasync: the attacker owns this node, so it can settle its own
+        # in-flight ack flush before garbling the outgoing values (this
+        # bare-Router harness has no tick drain; a real adversary's ack
+        # bytes are in hand the moment it chooses to send them)
+        d.drain_async()
         new_ids = sorted(d.key_gen.new_ids)
         for k, msg in enumerate(d.pending_kg):
             if msg[0] != "ack":

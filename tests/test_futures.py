@@ -1,0 +1,343 @@
+"""hbasync futures plane: fetch-exactly-once, ordering, drop loudness,
+the per-tick MSM coalescer, the seal-batch hoists, and the tier-1
+acceptance gate — a full sim era with the futures plane forced on and
+off commits identical batches and derives identical DKG outputs."""
+import gc
+import random
+
+import pytest
+
+from hydrabadger_tpu.crypto import dkg as dkg_mod
+from hydrabadger_tpu.crypto import futures
+from hydrabadger_tpu.crypto.engine import CpuEngine
+from hydrabadger_tpu.obs.metrics import default_registry
+
+
+# -- the future itself -------------------------------------------------------
+
+
+def test_result_materializes_exactly_once():
+    calls = []
+
+    fut = futures.submit(lambda: calls.append(1) or "value", "t")
+    assert not fut.done
+    assert fut.result() == "value"
+    assert fut.done
+    # idempotent fetch, single materialization: the protocol effect a
+    # result drives must happen exactly once
+    assert fut.result() == "value"
+    assert len(calls) == 1
+
+
+def test_result_recaches_and_reraises_failure():
+    calls = []
+
+    def dying():
+        calls.append(1)
+        raise RuntimeError("device fell over")
+
+    fut = futures.submit(dying, "dying")
+    with pytest.raises(RuntimeError, match="device fell over"):
+        fut.result()
+    # a retry re-raises the ORIGINAL error — never a silent None
+    with pytest.raises(RuntimeError, match="device fell over"):
+        fut.result()
+    assert len(calls) == 1  # the materializer itself still ran once
+
+
+def test_immediate_future_is_done_value():
+    fut = futures.immediate([1, 2, 3], "imm")
+    assert fut.result() == [1, 2, 3]
+    assert fut.result() == [1, 2, 3]
+
+
+def test_dropped_future_is_loud():
+    futures.reset_accounting()
+    dropped0 = default_registry().counter("crypto_futures_dropped").value
+    fut = futures.submit(lambda: "never fetched", "doomed")
+    del fut
+    gc.collect()
+    assert (
+        default_registry().counter("crypto_futures_dropped").value
+        == dropped0 + 1
+    )
+    # the raise-later surface for harness teardowns
+    with pytest.raises(RuntimeError, match="doomed"):
+        futures.check_dropped()
+    # check_dropped drains: a second call is clean
+    futures.check_dropped()
+
+
+def test_fetched_future_is_quiet_on_drop():
+    futures.reset_accounting()
+    fut = futures.submit(lambda: 1, "fine")
+    fut.result()
+    del fut
+    gc.collect()
+    futures.check_dropped()  # no raise
+
+
+# -- ordering: completion order is not protocol order ------------------------
+
+
+class FakeAsyncEngine(CpuEngine):
+    """Deterministic fake: the 'device' completes submissions in an
+    ADVERSARIAL order (reverse of submission); fetch/effect ordering
+    must not follow it."""
+
+    name = "fake-async"
+
+    def __init__(self):
+        self.submitted = []  # submission order
+        self.completed = []  # simulated device-completion order
+        self.materialized = []  # host fetch order
+
+    def submit_g1_msm_batch(self, jobs):
+        idx = len(self.submitted)
+        self.submitted.append(idx)
+
+        def materialize():
+            self.materialized.append(idx)
+            return [("job", idx, i) for i in range(len(jobs))]
+
+        return futures.submit(materialize, f"fake-{idx}")
+
+    def complete_on_device(self, order):
+        """The backend finishes batches whenever it pleases."""
+        self.completed.extend(order)
+
+
+def test_out_of_order_completion_cannot_reorder_effects():
+    eng = FakeAsyncEngine()
+    futs = [eng.submit_g1_msm_batch([(None, None)] * 2) for _ in range(3)]
+    # the device finishes them backwards
+    eng.complete_on_device([2, 1, 0])
+    effects = []
+    futures.settle_in_order(
+        futs, lambda i, value: effects.append((i, value[0][1]))
+    )
+    # effects applied strictly in SUBMISSION order, and each result is
+    # its own submission's (no cross-wiring through the adversarial
+    # completion schedule)
+    assert effects == [(0, 0), (1, 1), (2, 2)]
+    assert eng.materialized == [0, 1, 2]
+
+
+def test_fake_engine_results_fetched_exactly_once_each():
+    eng = FakeAsyncEngine()
+    futs = [eng.submit_g1_msm_batch([(None, None)]) for _ in range(4)]
+    for f in futs:
+        f.result()
+        f.result()  # cached — no re-materialization
+    assert eng.materialized == [0, 1, 2, 3]
+
+
+# -- overlap accounting ------------------------------------------------------
+
+
+def test_overlap_gauges_stamped():
+    futures.reset_accounting()
+    fut = futures.submit(lambda: 7, "g")
+    assert fut.result() == 7
+    snap = futures.overlap_snapshot()
+    assert 0.0 <= snap["device_overlap_ratio"] <= 1.0
+    reg = default_registry()
+    assert reg.gauge("device_overlap_ratio").value >= 0.0
+    assert reg.gauge("device_idle_s").value >= 0.0
+
+
+# -- the per-tick MSM coalescer ---------------------------------------------
+
+
+def test_msm_coalescer_merges_and_scatters(monkeypatch):
+    co = futures.MsmCoalescer()
+    dispatched = []
+
+    def fake_submit(all_jobs):
+        dispatched.append(list(all_jobs))
+        return lambda: [("r", j) for j in range(len(all_jobs))]
+
+    from hydrabadger_tpu.ops import msm_T
+
+    monkeypatch.setattr(msm_T, "g1_msm_batch_submit", fake_submit)
+    f1 = co.submit(["a", "b"], fallback=lambda: ["fb"] * 2)
+    f2 = co.submit(["c"], fallback=lambda: ["fb"])
+    assert co.depth == 2
+    # first settle flushes the WHOLE queue as one dispatch...
+    assert f1.result() == [("r", 0), ("r", 1)]
+    assert dispatched == [["a", "b", "c"]]
+    # ...and the second submission's slot was scattered from it
+    assert f2.result() == [("r", 2)]
+    assert co.depth == 0
+
+
+def test_msm_coalescer_fallback_on_device_failure(monkeypatch):
+    co = futures.MsmCoalescer()
+
+    def dying_submit(all_jobs):
+        raise RuntimeError("backend gone")
+
+    from hydrabadger_tpu.ops import msm_T
+
+    monkeypatch.setattr(msm_T, "g1_msm_batch_submit", dying_submit)
+    f1 = co.submit(["a"], fallback=lambda: ["host-a"])
+    f2 = co.submit(["b"], fallback=lambda: ["host-b"])
+    assert f1.result() == ["host-a"]
+    assert f2.result() == ["host-b"]
+
+
+def test_msm_coalescer_structural_error_attributed_to_its_submission(
+    monkeypatch,
+):
+    """A malformed job in ONE coalesced submission must not poison its
+    siblings: the combined dispatch fails, every submission falls back
+    per-slot, and only the malformed one's result() raises."""
+    co = futures.MsmCoalescer()
+
+    def structural(all_jobs):
+        raise ValueError("points/scalars length mismatch")
+
+    from hydrabadger_tpu.ops import msm_T
+
+    monkeypatch.setattr(msm_T, "g1_msm_batch_submit", structural)
+    good = co.submit(["a"], fallback=lambda: ["host-a"])
+
+    def bad_fallback():
+        raise ValueError("points/scalars length mismatch")
+
+    bad = co.submit(["b"], fallback=bad_fallback)
+    assert good.result() == ["host-a"]  # innocent sibling unharmed
+    with pytest.raises(ValueError, match="length mismatch"):
+        bad.result()
+
+
+def test_dropped_future_does_not_freeze_idle_clock():
+    futures.reset_accounting()
+    fut = futures.submit(lambda: 1, "leaky")
+    del fut
+    gc.collect()
+    with pytest.raises(RuntimeError, match="leaky"):
+        futures.check_dropped()  # loud, and drains the list
+    # a drop must leave the in-flight set: a later normal future still
+    # re-arms the idle clock
+    nxt = futures.submit(lambda: 2, "normal")
+    assert nxt.result() == 2
+    assert futures._inflight == 0
+
+
+def test_msm_coalescer_env_gate(monkeypatch):
+    monkeypatch.delenv("HYDRABADGER_COALESCE", raising=False)
+    assert futures.msm_coalescer() is None
+    monkeypatch.setenv("HYDRABADGER_COALESCE", "1")
+    assert futures.msm_coalescer() is not None
+
+
+# -- seal-batch hoists stay bit-identical ------------------------------------
+
+
+def test_seal_batch_matches_unbatched_seal():
+    rng = random.Random(11)
+    keys = [bytes([i]) * 32 for i in range(4)]
+    items = []
+    for i in range(40):
+        key = keys[i % len(keys)]  # repeated keys: the hoisted contexts
+        ctx = b"V|ctx|" + i.to_bytes(2, "big")
+        size = [32, 17, 33, 100][i % 4]  # single- and multi-block
+        msg = bytes(rng.getrandbits(8) for _ in range(size))
+        items.append((key, ctx, msg))
+    got = dkg_mod._seal_batch(items)
+    want = [dkg_mod._seal(k, c, m) for k, c, m in items]
+    assert got == want
+    # and the sealed values still open
+    for (k, c, m), blob in zip(items, got):
+        assert dkg_mod._open(k, c, blob) == m
+
+
+def test_val_ctx_prefix_hoist_identity():
+    rng = random.Random(3)
+    ids = [f"n{i}" for i in range(4)]
+    from hydrabadger_tpu.crypto.threshold import SecretKey
+
+    id_sks = {i: SecretKey.random(rng) for i in ids}
+    kg = dkg_mod.SyncKeyGen(
+        ids[0],
+        id_sks[ids[0]],
+        {i: s.public_key() for i, s in id_sks.items()},
+        1,
+        rng,
+        session=b"s7",
+    )
+    for p in range(3):
+        for s in range(3):
+            prefix = kg._val_ctx_prefix(p, s)
+            for m in range(4):
+                assert prefix + kg._idx2[m] == kg._val_ctx(p, s, m)
+
+
+# -- the acceptance gate: identical era with the plane on and off ------------
+
+
+def _run_era(async_on: bool):
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=5,
+            protocol="dhb",
+            txns_per_node_per_epoch=2,
+            txn_bytes=2,
+            seed=42,
+            async_dispatch=async_on,
+        )
+    )
+    net.run(1)
+    victim = net.ids[-1]
+    for nid in net.ids:
+        if nid != victim:
+            net.router.dispatch_step(
+                nid, net.nodes[nid].vote_to_remove(victim)
+            )
+    for _ in range(8):
+        net.run(1)
+        if all(
+            net.nodes[nid].era > 0 for nid in net.ids if nid != victim
+        ):
+            break
+    survivors = [nid for nid in net.ids if nid != victim]
+    assert all(net.nodes[nid].era > 0 for nid in survivors), "era switch"
+    net.run(1)  # one committed epoch in the new era
+    batches = {
+        nid: [
+            (
+                b.epoch,
+                b.era,
+                tuple(sorted(b.contributions.items())),
+                b.change,
+            )
+            for b in net.nodes[nid].batches
+        ]
+        for nid in survivors
+    }
+    pk_sets = {
+        nid: net.nodes[nid].netinfo.pk_set.to_bytes() for nid in survivors
+    }
+    shares = {
+        nid: net.nodes[nid].netinfo.sk_share.to_bytes()
+        for nid in survivors
+        if net.nodes[nid].netinfo.sk_share is not None
+    }
+    return batches, pk_sets, shares
+
+
+def test_async_and_sync_eras_are_point_identical():
+    """The tentpole's safety gate: a full dhb era — bootstrap, removal
+    vote, trustless DKG, era switch, post-switch epoch — with the
+    futures plane forced ON commits exactly the batches and derives
+    exactly the DKG outputs of the plane forced OFF."""
+    b_async, pk_async, sh_async = _run_era(True)
+    b_sync, pk_sync, sh_sync = _run_era(False)
+    assert b_async == b_sync
+    assert pk_async == pk_sync
+    assert len(set(pk_sync.values())) == 1  # and everyone agrees
+    assert sh_async == sh_sync
+    assert set(sh_sync) == set(pk_sync)  # every survivor derived a share

@@ -10,6 +10,7 @@ from hydrabadger_tpu import lint
 from hydrabadger_tpu.lint import (
     PACKAGE_ROOT,
     SourceFile,
+    async_fetch,
     callgraph,
     deadcode,
     jit_hygiene,
@@ -262,6 +263,67 @@ def test_deadcode_fires_on_known_bad(tmp_path):
     assert any("'hashlib'" in m for m in messages)
     assert not any("'sys'" in m for m in messages)
     assert not deadcode.applies("utils/__init__.py")  # re-export surface
+
+
+def test_eager_fetch_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "consensus/bad_async.py",
+        """\
+        import numpy as np
+
+        def flush(self, engine, jobs):
+            fut = engine.submit_g1_msm_batch(jobs)
+            points = fut.result()  # inline fetch: overlap thrown away
+            arr = np.asarray(fut)
+            items = list(fut)
+            one = fut.item()
+            direct = g1_msm_batch_submit(jobs).result()
+            return points, arr, items, one, direct
+        """,
+    )
+    messages = [f.message for f in async_fetch.check(sf)]
+    assert sum("not a registered fetch point" in m for m in messages) == 2
+    assert any("np.asarray" in m for m in messages)
+    assert any("list()" in m for m in messages)
+    assert any(".item()" in m for m in messages)
+    assert async_fetch.applies("crypto/dkg.py")
+    assert async_fetch.applies("consensus/dynamic_honey_badger.py")
+    assert not async_fetch.applies("crypto/futures.py")  # the machinery
+    assert not async_fetch.applies("crypto/engine.py")
+
+
+def test_eager_fetch_allows_registered_fetch_points(tmp_path):
+    # crypto/dkg.py::g1_msm_batch and ::settle are registered in
+    # lint/registry.py:ASYNC_FETCH_POINTS — the designed boundaries
+    sf = make_sf(
+        tmp_path,
+        "crypto/dkg.py",
+        """\
+        def g1_msm_batch(jobs):
+            return g1_msm_batch_submit(jobs).result()
+
+        def handle_parts_submit(self, items):
+            fut = g1_msm_batch_submit(items)
+
+            def settle():
+                return fut.result()
+
+            return settle
+        """,
+    )
+    assert async_fetch.check(sf) == []
+    # the same closure fetch OUTSIDE a registered point still fires
+    sf2 = make_sf(
+        tmp_path,
+        "crypto/threshold.py",
+        """\
+        def combine(self, items):
+            fut = g1_msm_batch_submit(items)
+            return fut.result()
+        """,
+    )
+    assert [f.rule for f in async_fetch.check(sf2)] == ["eager-fetch"]
 
 
 # -- suppression mechanics ---------------------------------------------------

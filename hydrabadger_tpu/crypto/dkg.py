@@ -216,6 +216,64 @@ def g1_msm_batch(jobs):
     return g1_msm_batch_submit(jobs).result()
 
 
+# ---------------------------------------------------------------------------
+# Fr multipoint evaluation / interpolation (the NTT plane, ROADMAP
+# item 1): share generation evaluates every row polynomial at ALL n
+# node indices — n Horner passes of O(t) each, O(n^3 t) per era across
+# the quorum.  Above a size threshold the consecutive node indices
+# route through ops/fr_poly's Newton-basis convolution (O(t^2) seed +
+# O(n log n) NTT convolutions) — identical residues by construction,
+# pinned by tests/test_ntt.py.  The threshold default (384) sits at
+# the measured host crossover; HYDRABADGER_NTT_MIN_N overrides it and
+# HYDRABADGER_NTT=0 pins Horner everywhere (the fallback).  fr_poly is
+# jax-free on purpose: this path runs inside TCP keygen handlers.
+# ---------------------------------------------------------------------------
+
+
+def _ntt_route(n_points: int, degree: int) -> bool:
+    import os
+
+    if os.environ.get("HYDRABADGER_NTT", "1") == "0":
+        return False
+    env = os.environ.get("HYDRABADGER_NTT_MIN_N", "")
+    floor = int(env) if env else 384
+    return n_points >= floor and degree >= 8
+
+
+def fr_eval_points_batch(rows, xs) -> List[List[int]]:
+    """Evaluate each coefficient row at every x in xs.  One batched
+    plane call for the whole poll — every row shares the cached
+    factorial/twiddle tables — instead of len(rows) * len(xs) Horner
+    passes; below the threshold (or for non-consecutive point sets,
+    which fr_poly itself Horner-routes) the reference loops run
+    unchanged.  This is the routing CryptoEngine.fr_poly_eval_batch
+    exposes to the protocol layers."""
+    rows = [list(r) for r in rows]
+    xs = [int(x) for x in xs]
+    if rows and _ntt_route(
+        len(xs), max(len(r) for r in rows) - 1
+    ):
+        from ..ops import fr_poly
+
+        return fr_poly.eval_many(rows, xs)
+    return [[poly_eval(row, x) for x in xs] for row in rows]
+
+
+def fr_interpolate_at_zero(points) -> int:
+    """f(0) from t+1 (x, y) samples; consecutive node runs (the
+    honest-majority generate() shape) collapse to O(t) factorial
+    Lagrange weights, identical residues.  Own floor (64, no NTT
+    involved — the win is the factorial collapse, which pays far
+    earlier than the convolution route)."""
+    import os
+
+    if len(points) >= 64 and os.environ.get("HYDRABADGER_NTT", "1") != "0":
+        from ..ops import fr_poly
+
+        return fr_poly.interpolate_at_zero(dict(points))
+    return poly_interpolate_at_zero(points)
+
+
 def _keystream_xor(key: bytes, ctx: bytes, data: bytes) -> bytes:
     """XOR with the SHA-256 counter keystream (one int-wide XOR — the
     byte-wise generator was measurable at era-switch volume)."""
@@ -346,6 +404,20 @@ class BivarPoly:
         return [
             sum(xs[j] * self.coeffs[j][k] for j in range(self.t + 1)) % R
             for k in range(self.t + 1)
+        ]
+
+    def rows_batch(self, xs) -> List[List[int]]:
+        """Rows f(x, ·) for EVERY x in xs as one multipoint-plane
+        call: the t+1 column polynomials (coefficient index j) each
+        evaluate at all xs — O(t n log n) routed vs the per-recipient
+        row() loop's O(n t^2); residues identical either way."""
+        t1 = self.t + 1
+        cols = [
+            [self.coeffs[j][k] for j in range(t1)] for k in range(t1)
+        ]
+        vals = fr_eval_points_batch(cols, xs)
+        return [
+            [vals[k][i] for k in range(t1)] for i in range(len(vals[0]))
         ]
 
     def commitment(self) -> "BivarCommitment":
@@ -719,12 +791,15 @@ class SyncKeyGen(Generic[N]):
         commit = poly.commitment()
         self.warm_channel_keys()  # one batched derivation for the era
         row_prefix = b"R" + self.session + b"|" + self._idx2[self.our_idx]
+        # all recipients' rows through the multipoint plane at once
+        # (fr_eval_points_batch routes; small n = the same per-row math)
+        rows = poly.rows_batch(range(1, len(self.node_ids) + 1))
         enc_rows = _seal_batch(
             [
                 (
                     self._chan_key(m),
                     row_prefix + self._idx2[m],
-                    codec.encode(poly.row(m + 1)),
+                    codec.encode(rows[m]),
                 )
                 for m in range(len(self.node_ids))
             ]
@@ -902,9 +977,18 @@ class SyncKeyGen(Generic[N]):
         n_nodes = len(self.node_ids)
         keys = [self._chan_key(m) for m in range(n_nodes)]
         idx2 = self._idx2
+        # every pending row evaluates at ALL n node indices through the
+        # multipoint plane (ONE batched call for the poll — the round-6
+        # per-recipient Horner loop was n^2 t per poll); values include
+        # our own consistent f_s(our_idx+1, our_idx+1) at m = our_idx
+        all_vals = fr_eval_points_batch(
+            [row for _i, _s, _st, row, _raw, _p in pending],
+            range(1, n_nodes + 1),
+        )
         pre_acks = []
-        for _i, s, _state, row, _raw, _part in pending:
-            # our own consistent value: f_s(our_idx+1, our_idx+1)
+        for (_i, s, _state, _row, _raw, _part), vals in zip(
+            pending, all_vals
+        ):
             prefix = self._val_ctx_prefix(s, self.our_idx)
             pre_acks.append(
                 _seal_batch(
@@ -912,7 +996,7 @@ class SyncKeyGen(Generic[N]):
                         (
                             keys[m],
                             prefix + idx2[m],
-                            poly_eval(row, m + 1).to_bytes(32, "big"),
+                            vals[m].to_bytes(32, "big"),
                         )
                         for m in range(n_nodes)
                     ]
@@ -1106,5 +1190,5 @@ class SyncKeyGen(Generic[N]):
                     "(more than t Byzantine ackers?)"
                 )
             pts = dict(list(state.values.items())[: t + 1])
-            sk_val = (sk_val + poly_interpolate_at_zero(pts)) % R
+            sk_val = (sk_val + fr_interpolate_at_zero(pts)) % R
         return PublicKeySet(commit_acc), SecretKeyShare(sk_val)

@@ -213,6 +213,34 @@ class CpuEngine:
 
         return [g1_msm_or_fallback(pts, ks) for pts, ks in jobs]
 
+    # -- Fr multipoint evaluation (the DKG NTT plane, ROADMAP item 1) -------
+
+    def fr_poly_eval_batch(
+        self, rows: Sequence[Sequence[int]], xs: Sequence[int]
+    ) -> List[List[int]]:
+        """Evaluate every coefficient row at every point: the DKG's
+        share-generation inner loop as ONE batched plane call.  Both
+        engines share the host route (crypto/dkg.fr_eval_points_batch
+        — Horner below the size threshold, the jax-free Newton/NTT
+        convolution of ops/fr_poly above it): Fr is 255-bit host
+        arithmetic, there is no device tier to split on, and residues
+        are pinned identical either way."""
+        from .dkg import fr_eval_points_batch
+
+        return fr_eval_points_batch(rows, xs)
+
+    def submit_fr_poly_eval_batch(
+        self, rows: Sequence[Sequence[int]], xs: Sequence[int]
+    ) -> "futures.CryptoFuture":
+        """Future twin (PR-5 hbasync contract): the work is host math
+        on every engine, so the future is immediate — consumers
+        written against the submit API stay engine-agnostic."""
+        from . import futures
+
+        return futures.immediate(
+            self.fr_poly_eval_batch(rows, xs), "fr_poly_eval_batch"
+        )
+
     # -- threshold encryption (hbbft::threshold_decrypt) --------------------
 
     def encrypt(self, pk: th.PublicKey, msg: bytes, rng) -> th.Ciphertext:
@@ -480,9 +508,37 @@ class TpuEngine(CpuEngine):
 
     name = "tpu"
 
+    @staticmethod
+    def _rs_route_ntt(data_shards: int, parity_shards: int) -> bool:
+        """Batch-plane FFT routing: EXPLICIT opt-in only.  The host
+        threshold default (crypto/rs._ntt_min_shards) is calibrated
+        against host matmuls and keys on the NATIVE library — the
+        wrong signal for this engine, whose baseline is the fully-on-
+        device rs_jax bit-matmul (one MXU dispatch, async submit
+        twins).  Auto-routing would silently trade that for a mostly-
+        host pipeline on exactly the largest geometries, so the FFT
+        batch route engages only when the operator sets
+        HYDRABADGER_NTT_MIN_SHARDS themselves (and the kill switch is
+        off)."""
+        import os
+
+        from .rs import _ntt_enabled
+
+        env = os.environ.get("HYDRABADGER_NTT_MIN_SHARDS", "")
+        return (
+            bool(env)
+            and parity_shards > 0
+            and data_shards + parity_shards >= int(env)
+            and _ntt_enabled()
+        )
+
     def rs_encode_batch(
         self, data, data_shards: int, parity_shards: int
     ) -> np.ndarray:
+        if self._rs_route_ntt(data_shards, parity_shards):
+            from ..ops import rs_fft
+
+            return rs_fft.encode_batch(data, data_shards, parity_shards)
         from ..ops import rs_jax
 
         out = rs_jax.rs_encode_batch(data, data_shards, parity_shards)
@@ -491,6 +547,18 @@ class TpuEngine(CpuEngine):
     def rs_reconstruct_batch(
         self, surviving, rows: Sequence[int], data_shards: int, parity_shards: int
     ) -> np.ndarray:
+        if self._rs_route_ntt(data_shards, parity_shards):
+            from ..ops import rs_fft
+
+            surviving = np.asarray(surviving, dtype=np.uint8)
+            out = rs_fft.reconstruct_rows(
+                np.moveaxis(surviving, 1, 0),
+                rows,
+                range(data_shards),
+                data_shards,
+                parity_shards,
+            )
+            return np.moveaxis(out, 0, 1)
         from ..ops import rs_jax
 
         out = rs_jax.rs_reconstruct_batch(
@@ -608,6 +676,14 @@ class TpuEngine(CpuEngine):
     ) -> "futures.CryptoFuture":
         from . import futures
 
+        if self._rs_route_ntt(data_shards, parity_shards):
+            # the FFT pipeline materializes host-side (its dominant
+            # transform may dispatch, but interpolation is host work),
+            # so the future is honestly immediate
+            return futures.immediate(
+                self.rs_encode_batch(data, data_shards, parity_shards),
+                "rs_encode_batch",
+            )
         from ..ops import rs_jax
 
         out = rs_jax.rs_encode_batch(data, data_shards, parity_shards)
@@ -620,6 +696,13 @@ class TpuEngine(CpuEngine):
     ) -> "futures.CryptoFuture":
         from . import futures
 
+        if self._rs_route_ntt(data_shards, parity_shards):
+            return futures.immediate(
+                self.rs_reconstruct_batch(
+                    surviving, rows, data_shards, parity_shards
+                ),
+                "rs_reconstruct_batch",
+            )
         from ..ops import rs_jax
 
         out = rs_jax.rs_reconstruct_batch(

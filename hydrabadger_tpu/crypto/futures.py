@@ -109,11 +109,40 @@ def _note_drop(now: float) -> None:
         _idle_since = now
 
 
+def device_backend() -> str:
+    """Backend provenance for the overlap gauges: the ratio is only
+    meaningful when an accelerator backend was live behind the plane —
+    a CPU-only host honestly reads 0.0 (nothing was deferred), which
+    is otherwise indistinguishable from "the overlap architecture
+    regressed".  Never imports jax unprompted (the crypto/dkg
+    discipline): an unloaded jax IS the provenance "none"."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return "none"
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:  # pragma: no cover - backend probe failure
+        return "unknown"
+
+
+def _backend_is_device(backend: str) -> bool:
+    return backend in ("tpu", "gpu")
+
+
 def stamp_gauges(reg=None) -> None:
     """Write the cumulative overlap/idle gauges into ``reg`` (default:
     the process registry) — called at every fetch boundary and by the
-    sim/bench drains that surface the numbers in their rows."""
-    from ..obs.metrics import DEVICE_IDLE_S, DEVICE_OVERLAP_RATIO
+    sim/bench drains that surface the numbers in their rows.  The
+    provenance gauge rides along so exported snapshots can tell a
+    CPU-only 0.0 from a regression 0.0."""
+    from ..obs.metrics import (
+        DEVICE_IDLE_S,
+        DEVICE_OVERLAP_HAS_DEVICE,
+        DEVICE_OVERLAP_RATIO,
+    )
 
     reg = reg if reg is not None else _registry()
     total = _overlap_s + _block_s
@@ -121,13 +150,25 @@ def stamp_gauges(reg=None) -> None:
         round(_overlap_s / total, 4) if total else 0.0
     )
     reg.gauge(DEVICE_IDLE_S).set(round(_idle_s, 4))
+    reg.gauge(DEVICE_OVERLAP_HAS_DEVICE).set(
+        1 if _backend_is_device(device_backend()) else 0
+    )
 
 
 def overlap_snapshot() -> dict:
-    """The plane's cumulative accounting as one JSON-able dict."""
+    """The plane's cumulative accounting as one JSON-able dict.
+    ``device_overlap_ratio`` reads ``"n/a (no device)"`` on hosts
+    without an accelerator backend — the raw 0.0 stays available in
+    ``device_overlap_ratio_raw`` for mechanical consumers."""
     total = _overlap_s + _block_s
+    ratio = round(_overlap_s / total, 4) if total else 0.0
+    backend = device_backend()
     return {
-        "device_overlap_ratio": round(_overlap_s / total, 4) if total else 0.0,
+        "device_overlap_ratio": (
+            ratio if _backend_is_device(backend) else "n/a (no device)"
+        ),
+        "device_overlap_ratio_raw": ratio,
+        "device_backend": backend,
         "device_overlap_s": round(_overlap_s, 4),
         "device_block_s": round(_block_s, 4),
         "device_idle_s": round(_idle_s, 4),

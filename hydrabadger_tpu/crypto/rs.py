@@ -16,6 +16,7 @@ hydrabadger_tpu.ops.rs_jax and is tested bit-equal to this module.
 """
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import Optional, Sequence
 
@@ -27,6 +28,37 @@ from . import _native
 
 class ReedSolomonError(ValueError):
     pass
+
+
+# ---------------------------------------------------------------------------
+# NTT routing (ROADMAP item 1): above a shard-count threshold the
+# encode/reconstruct/verify linear maps evaluate through the additive-
+# FFT plane (ops/rs_fft) — O(n log n) transforms instead of O(n^2)
+# matrix rows, byte-identical by construction (the matrix IS the
+# interpolate-then-evaluate map the plane computes exactly).
+#
+# The default threshold is calibrated, not aspirational: with the
+# native C++ SIMD matmul present the matrix path wins at every
+# n <= 255 (GF(2^8) caps total shards), so the route only engages by
+# default on hosts WITHOUT the native library, where the numpy matmul
+# fallback goes quadratic (measured crossover n ~ 128; 1.7x at 255 —
+# bench.py --config 10 records the sweep).  HYDRABADGER_NTT_MIN_SHARDS
+# overrides the threshold; HYDRABADGER_NTT=0 pins the matrix path
+# everywhere (the pinned-identical fallback).
+# ---------------------------------------------------------------------------
+
+_NTT_OFF_THRESHOLD = 1 << 30  # never routes: n is capped at 255
+
+
+def _ntt_enabled() -> bool:
+    return os.environ.get("HYDRABADGER_NTT", "1") != "0"
+
+
+def _ntt_min_shards() -> int:
+    env = os.environ.get("HYDRABADGER_NTT_MIN_SHARDS", "")
+    if env:
+        return int(env)
+    return 128 if not _native.native_available() else _NTT_OFF_THRESHOLD
 
 
 @lru_cache(maxsize=256)
@@ -68,6 +100,26 @@ class ReedSolomon:
         self.total_shards = self.data_shards + self.parity_shards
         self.matrix = encode_matrix(self.data_shards, self.parity_shards)
 
+    def _route_ntt(self) -> bool:
+        """FFT-plane routing decision for this codec's geometry (the
+        small-n path stays the untouched matrix route)."""
+        return (
+            self.parity_shards > 0
+            and self.total_shards >= _ntt_min_shards()
+            and _ntt_enabled()
+        )
+
+    def _parity_of(self, data: np.ndarray) -> np.ndarray:
+        """[k, L] -> [p, L] parity rows, FFT-routed above threshold;
+        both routes emit identical bytes (tests/test_ntt.py)."""
+        if self._route_ntt():
+            from ..ops import rs_fft
+
+            return rs_fft.encode_parity(
+                data, self.data_shards, self.parity_shards
+            )
+        return _native.gf_matmul(self.matrix[self.data_shards :], data)
+
     # -- encoding -----------------------------------------------------------
 
     def encode(self, data: np.ndarray) -> np.ndarray:
@@ -77,7 +129,7 @@ class ReedSolomon:
             raise ReedSolomonError(
                 f"expected [{self.data_shards}, L] data, got {data.shape}"
             )
-        parity = _native.gf_matmul(self.matrix[self.data_shards :], data)
+        parity = self._parity_of(data)
         return np.concatenate([data, parity], axis=0)
 
     def encode_bytes(self, payload: bytes) -> list[bytes]:
@@ -125,6 +177,36 @@ class ReedSolomon:
         out: list[Optional[np.ndarray]] = [
             arrs.get(i) for i in range(self.total_shards)
         ]
+        if self._route_ntt():
+            # one interpolation + one forward transform recovers EVERY
+            # missing row (data and parity) — byte-identical to the
+            # matrix-inverse route below
+            from ..ops import rs_fft
+
+            missing = [
+                i
+                for i in range(
+                    self.data_shards
+                    if data_only
+                    else self.total_shards
+                )
+                if out[i] is None
+            ]
+            if missing:
+                rows = present[: self.data_shards]
+                stacked = np.stack([arrs[i] for i in rows])  # [k, L]
+                recovered = rs_fft.reconstruct_rows(
+                    stacked,
+                    rows,
+                    missing,
+                    self.data_shards,
+                    self.parity_shards,
+                )
+                for row, i in enumerate(missing):
+                    out[i] = recovered[row]
+            return (
+                [o for o in out if o is not None] if data_only else out  # type: ignore
+            )
         missing_data = [i for i in range(self.data_shards) if out[i] is None]
         if missing_data:
             rows = present[: self.data_shards]
@@ -161,9 +243,10 @@ class ReedSolomon:
         return joined[4 : 4 + length]
 
     def verify(self, shards: Sequence[np.ndarray]) -> bool:
-        """Check parity rows match the data rows."""
+        """Check parity rows match the data rows (parity recompute
+        rides the same FFT/matrix routing as encode)."""
         data = np.stack([np.asarray(s, dtype=np.uint8) for s in shards[: self.data_shards]])
-        parity = _native.gf_matmul(self.matrix[self.data_shards :], data)
+        parity = self._parity_of(data)
         got = np.stack(
             [np.asarray(s, dtype=np.uint8) for s in shards[self.data_shards :]]
         )

@@ -296,4 +296,12 @@ CONFIG_BOUNDED_JIT = {
     "ops/gf256_jax.py::_gf_matmul_pallas": (
         "tile_l is a static_argname; operand shapes per config"
     ),
+    "ops/afft_T.py::_afft_fwd_T": (
+        "additive-FFT lanes: m is a static_argname capped at 8 "
+        "(GF(2^8) has 256 points), tail = the shard/batch geometry of "
+        "one RS config (rs_fft plans are geometry-cached)"
+    ),
+    "ops/afft_T.py::_afft_inv_T": (
+        "same [2^m, tail] geometry as _afft_fwd_T"
+    ),
 }

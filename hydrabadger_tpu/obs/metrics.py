@@ -28,8 +28,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 #       degenerated to synchronous dispatch — a regression tripwire).
 #   DEVICE_IDLE_S — cumulative wall with nothing in flight between one
 #       fetch completing and the next submit: pipeline headroom.
+#   DEVICE_OVERLAP_HAS_DEVICE — backend provenance for the ratio: 1
+#       when an accelerator backend (tpu/gpu) was live behind the
+#       futures plane, 0 otherwise.  A CPU-only run honestly reads
+#       ratio = 0.0 (nothing was deferred), which is indistinguishable
+#       from "the overlap plane regressed" WITHOUT this gauge —
+#       consumers (bench config-5, soak rows) must render the ratio as
+#       "n/a (no device)" when it reads 0.
 DEVICE_OVERLAP_RATIO = "device_overlap_ratio"
 DEVICE_IDLE_S = "device_idle_s"
+DEVICE_OVERLAP_HAS_DEVICE = "device_overlap_has_device"
 
 
 class Counter:

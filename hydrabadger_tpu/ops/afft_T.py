@@ -1,0 +1,144 @@
+"""Jitted device twins of the Cantor-basis additive FFT (ops/ntt_T).
+
+Split from ntt_T on purpose: the numpy transform plane is consumed by
+the HOST Reed-Solomon path (crypto/rs above the NTT threshold), which
+must never import jax as a side effect of handling consensus traffic —
+the crypto/dkg._accel_mode discipline.  This module owns the only jax
+dependency of the plane; ntt_T.gf_afft_dispatch imports it lazily in
+its device branch, so jax loads only when a device route is actually
+taken.
+
+Kernel contract mirrors ntt_T's numpy twins exactly (bit-equal, pinned
+by tests/test_ntt.py): uint8 lanes, [2^m, *tail] shapes, the Taylor
+shuffles as contiguous-slice XORs and the butterfly twiddle multiply
+as a log/exp gather under an int32 mask — Mosaic-clean throughout (no
+strided slices, no bool vectors, no dynamic_slice).
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import gf256
+from .ntt_T import _cantor_plan
+
+
+@lru_cache(maxsize=1)
+def _tables():
+    """(exp, log) GF(2^8) tables, host-side.  Kept as numpy on purpose:
+    converting to device arrays inside a traced body would cache
+    tracers across jit scopes; as numpy they fold into each jaxpr as
+    constants instead."""
+    return (
+        np.asarray(gf256.EXP_TABLE, dtype=np.int32),
+        np.asarray(gf256.LOG_TABLE, dtype=np.int32),
+    )
+
+
+def _mul_const_j(consts: np.ndarray, v: jax.Array) -> jax.Array:
+    """GF product of host-constant [h] twiddles against traced
+    [..., h, *tail] lanes: log-gather + masked exp-gather; the
+    all-int32 mask keeps the body Mosaic-clean."""
+    exp_np, log_np = _tables()
+    shape = [1] * v.ndim
+    shape[1] = len(consts)
+    clog = log_np[consts.astype(np.int64)].reshape(shape)
+    czero = (consts == 0).astype(np.int32).reshape(shape)
+    v32 = v.astype(jnp.int32)
+    out = jnp.take(jnp.asarray(exp_np), clog + jnp.take(jnp.asarray(log_np), v32))
+    mask = jnp.maximum(czero, (v32 == 0).astype(jnp.int32))
+    return jnp.where(mask == 1, 0, out).astype(jnp.uint8)
+
+
+def _taylor_j(work: jax.Array) -> jax.Array:
+    b, s = work.shape[:2]
+    tail = work.shape[2:]
+    size = s
+    while size >= 4:
+        x = work.reshape((-1, size) + tail)
+        q = size // 4
+        a = x[:, :q]
+        bq = x[:, q : 2 * q]
+        c = x[:, 2 * q : 3 * q]
+        d = x[:, 3 * q :]
+        x = jnp.concatenate([a, bq ^ c ^ d, c ^ d, d], axis=1)
+        work = x.reshape((b, s) + tail)
+        size //= 2
+    return work
+
+
+def _itaylor_j(work: jax.Array) -> jax.Array:
+    b, s = work.shape[:2]
+    tail = work.shape[2:]
+    size = 4
+    while size <= s:
+        x = work.reshape((-1, size) + tail)
+        q = size // 4
+        a = x[:, :q]
+        bq = x[:, q : 2 * q]
+        c = x[:, 2 * q : 3 * q]
+        d = x[:, 3 * q :]
+        x = jnp.concatenate([a, bq ^ c, c ^ d, d], axis=1)
+        work = x.reshape((b, s) + tail)
+        size *= 2
+    return work
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _afft_fwd_T(coeffs: jax.Array, m: int) -> jax.Array:
+    """Device twin of ntt_T.gf_afft: [2^m, *tail] uint8, one dispatch."""
+    _basis, _pts, pt2, _slot = _cantor_plan()
+    n = 1 << m
+    tail = coeffs.shape[1:]
+    work = coeffs.reshape((1, n) + tail)
+    s = n
+    while s >= 2:
+        work = _taylor_j(work)
+        b = work.shape[0]
+        w2 = work.reshape((b, s // 2, 2) + tail)
+        work = jnp.stack((w2[:, :, 0], w2[:, :, 1]), axis=1).reshape(
+            (2 * b, s // 2) + tail
+        )
+        s //= 2
+    b, h = n, 1
+    vals = work
+    while h < n:
+        b2 = b // 2
+        w = vals.reshape((b2, 2, h) + tail)
+        u = w[:, 0]
+        v = w[:, 1]
+        w0 = u ^ _mul_const_j(pt2[:h], v)
+        vals = jnp.stack((w0, w0 ^ v), axis=2).reshape(
+            (b2, 2 * h) + tail
+        )
+        b, h = b2, 2 * h
+    return vals.reshape((n,) + tail)
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _afft_inv_T(vals: jax.Array, m: int) -> jax.Array:
+    """Device twin of ntt_T.gf_iafft."""
+    _basis, _pts, pt2, _slot = _cantor_plan()
+    n = 1 << m
+    tail = vals.shape[1:]
+    work = vals.reshape((1, n) + tail)
+    b, h = 1, n
+    while h > 1:
+        w = work.reshape((b, h // 2, 2) + tail)
+        v = w[:, :, 0] ^ w[:, :, 1]
+        u = w[:, :, 0] ^ _mul_const_j(pt2[: h // 2], v)
+        work = jnp.stack((u, v), axis=1).reshape((2 * b, h // 2) + tail)
+        b, h = 2 * b, h // 2
+    s = 1
+    while s < n:
+        b2 = work.shape[0] // 2
+        w = work.reshape((b2, 2, s) + tail)
+        merged = jnp.stack((w[:, 0], w[:, 1]), axis=2).reshape(
+            (b2, 2 * s) + tail
+        )
+        work = _itaylor_j(merged)
+        s *= 2
+    return work.reshape((n,) + tail)

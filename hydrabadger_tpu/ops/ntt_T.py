@@ -1,0 +1,290 @@
+"""NTT/FFT transform plane — quorum size as a batch dimension.
+
+ROADMAP open item 1: every DKG part/ack fold and every RS encode ran
+through O(n^2) Vandermonde/schoolbook polynomial evaluation
+(ops/vandermonde_T, crypto/rs), and that quadratic term IS the
+128-node era-switch wall.  This module is the transform layer that
+turns it into ~n log n, following the hybrid NTT dataflow of Hermes
+(PAPERS.md: arxiv 2603.01556) and the FFT share-evaluation tricks of
+the efficient-Shamir paper (arxiv 2108.05982).  Two transforms:
+
+* **Radix-2/4 NTT over Fr** (the BLS12-381 scalar field).  Fr - 1 =
+  2^32 * odd, so roots of unity exist for every power-of-two size up
+  to 2^32 — far beyond any validator-set ceiling.  The recursion
+  takes radix-4 steps (25% fewer twiddle muls than radix-2, the
+  butterfly reuses the quarter-order root I) and falls back to one
+  radix-2 layer on odd log2 sizes.  Host Python-int arithmetic on
+  purpose: Fr elements are 255-bit, the repo's device planes carry
+  CURVE POINTS in limb layout, and a scalar-field limb NTT would buy
+  nothing at validator-set sizes — the win here is algorithmic
+  (``ops/fr_poly`` builds O(n log n) multipoint evaluation on top).
+
+* **Additive (Cantor-basis) FFT over GF(2^8)** — the Reed-Solomon
+  byte plane.  GF(256) = GF(2^{2^3}) admits a full Cantor basis
+  v_1..v_8 (v_1 = 1, v_{i+1}^2 + v_{i+1} = v_i), under which the
+  Gao-Mateer radix-2(x^2+x) recursion needs NO twisting: one Taylor
+  shuffle (pure XOR) + one masked table-multiply per level, so a full
+  256-point evaluation costs O(n log n) byte-ops, vectorised over the
+  trailing axes (shard bytes x instance batch — the whole batch rides
+  one call).  The numpy twin here is the host/reference path
+  (bit-exact to naive evaluation); the jitted device twins live in
+  ``ops/afft_T`` (``_afft_fwd_T`` / ``_afft_inv_T``) and are imported
+  LAZILY by ``gf_afft_dispatch``'s device branch only — this module
+  and everything the host RS/DKG routes touch stay jax-free, so a
+  routed encode inside a consensus handler never loads an
+  accelerator runtime (the crypto/dkg._accel_mode discipline).
+
+Evaluation-point order: slot j of a forward AFFT holds the value at
+``AFFT_POINTS[j] = XOR of v_{i+1} over set bits i of j``; with m = 8
+that enumerates ALL of GF(256), so evaluation at an arbitrary point
+set (the RS code's alpha^i locators) is a constant gather off the
+transform output (``AFFT_SLOT_OF[element]``).
+
+Lane-occupancy accounting mirrors ops/msm_T: every transform notes
+dispatched vs real lanes (zero-padding to the 2^m grid) in the
+default metrics registry (``ntt_batch_lanes`` / ``ntt_pad_lanes`` /
+``ntt_real_lanes``).
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Sequence
+
+import numpy as np
+
+from ..crypto import gf256
+
+# ---------------------------------------------------------------------------
+# Fr radix-2/4 NTT — implemented in ops/fr_poly (pure host Python, no
+# jax: the DKG keygen path imports it without touching an accelerator
+# runtime); re-exported here as the transform plane's public surface.
+# ---------------------------------------------------------------------------
+
+from . import fr_poly as _frp
+
+FR_TWO_ADICITY = _frp.FR_TWO_ADICITY
+FR_GENERATOR = _frp.FR_GENERATOR
+FR_ROOT_OF_UNITY = _frp.FR_ROOT_OF_UNITY
+
+
+def fr_ntt(vec: Sequence[int], invert: bool = False) -> List[int]:
+    """Radix-2/4 NTT over Fr (see ops/fr_poly.fr_ntt)."""
+    return _frp.fr_ntt(vec, invert)
+
+
+def fr_intt(vec: Sequence[int]) -> List[int]:
+    """Inverse NTT (scaled): fr_intt(fr_ntt(v)) == v."""
+    return _frp.fr_intt(vec)
+
+
+def fr_poly_mul(a: Sequence[int], b: Sequence[int]) -> List[int]:
+    """Polynomial product over Fr via the NTT (coeffs low-to-high)."""
+    return _frp.fr_poly_mul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Cantor basis for GF(2^8)
+# ---------------------------------------------------------------------------
+
+_MUL = gf256.MUL_TABLE
+
+
+@lru_cache(maxsize=1)
+def _cantor_plan():
+    """(basis, points, pt2, slot_of): the Cantor basis v_1..v_8 under
+    gf256's 0x11d representation, the AFFT point order, the per-level
+    butterfly twiddle table and the element->slot permutation."""
+    basis = [1]
+    for _ in range(7):
+        target = basis[-1]
+        root = next(
+            (r for r in range(256) if (int(_MUL[r, r]) ^ r) == target),
+            None,
+        )
+        if root is None:  # pragma: no cover - algebra guarantees a root
+            raise RuntimeError(f"no Artin-Schreier root for {target}")
+        basis.append(root)
+    pts = np.zeros(256, dtype=np.uint8)
+    for j in range(256):
+        acc = 0
+        for i in range(8):
+            if (j >> i) & 1:
+                acc ^= basis[i]
+        pts[j] = acc
+    if len(set(int(p) for p in pts)) != 256:  # pragma: no cover
+        raise RuntimeError("Cantor basis is degenerate")
+    # butterfly twiddles: the zero-v1 preimage of local point k under
+    # x^2+x is pts[2k] at EVERY level (the basis shift is depth-free)
+    pt2 = np.asarray([pts[2 * k] for k in range(128)], dtype=np.uint8)
+    slot_of = np.zeros(256, dtype=np.int64)
+    for j in range(256):
+        slot_of[int(pts[j])] = j
+    return tuple(basis), pts, pt2, slot_of
+
+
+def afft_points() -> np.ndarray:
+    """[256] uint8: slot j of a forward transform evaluates at this."""
+    return _cantor_plan()[1]
+
+
+def afft_slot_of() -> np.ndarray:
+    """[256] int: transform output slot holding each field element."""
+    return _cantor_plan()[3]
+
+
+# ---------------------------------------------------------------------------
+# numpy AFFT twin (host/reference path)
+# ---------------------------------------------------------------------------
+
+
+def _mul_const_np(consts: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """Elementwise GF product of a [h] constant vector against
+    [..., h, *tail] data (constants broadcast over leading/trailing)."""
+    shape = [1] * v.ndim
+    shape[1] = len(consts)
+    return _MUL[consts.reshape(shape), v]
+
+
+def _taylor_np(work: np.ndarray) -> np.ndarray:
+    """Taylor expansion in (x^2+x) of every block, vectorised: work is
+    [B, s, *tail]; blocks shrink s -> 4 level by level (pure XOR)."""
+    b, s = work.shape[:2]
+    tail = work.shape[2:]
+    size = s
+    while size >= 4:
+        x = work.reshape((-1, size) + tail)
+        q = size // 4
+        a = x[:, :q]
+        bq = x[:, q : 2 * q]
+        c = x[:, 2 * q : 3 * q]
+        d = x[:, 3 * q :]
+        nb = bq ^ c ^ d
+        nc = c ^ d
+        x = np.concatenate([a, nb, nc, d], axis=1)
+        work = x.reshape((b, s) + tail)
+        size //= 2
+    return work
+
+
+def _itaylor_np(work: np.ndarray) -> np.ndarray:
+    """Inverse of _taylor_np (ascending block sizes)."""
+    b, s = work.shape[:2]
+    tail = work.shape[2:]
+    size = 4
+    while size <= s:
+        x = work.reshape((-1, size) + tail)
+        q = size // 4
+        a = x[:, :q]
+        bq = x[:, q : 2 * q]
+        c = x[:, 2 * q : 3 * q]
+        d = x[:, 3 * q :]
+        oc = c ^ d
+        ob = bq ^ c  # bq ^ (c ^ d) ^ d == original b
+        x = np.concatenate([a, ob, oc, d], axis=1)
+        work = x.reshape((b, s) + tail)
+        size *= 2
+    return work
+
+
+def gf_afft(coeffs: np.ndarray, m: int) -> np.ndarray:
+    """Forward additive FFT: [2^m, *tail] uint8 coefficients ->
+    [2^m, *tail] evaluations at afft_points()[:2^m]."""
+    _basis, _pts, pt2, _slot = _cantor_plan()
+    n = 1 << m
+    work = np.ascontiguousarray(coeffs, dtype=np.uint8)
+    if work.shape[0] != n:
+        raise ValueError(f"expected {n} coefficients, got {work.shape[0]}")
+    tail = work.shape[1:]
+    work = work.reshape((1, n) + tail)
+    # down pass: Taylor shuffle + even/odd split, all subproblems batched
+    s = n
+    while s >= 2:
+        work = _taylor_np(work)
+        b = work.shape[0]
+        w2 = work.reshape((b, s // 2, 2) + tail)
+        g0 = w2[:, :, 0]
+        g1 = w2[:, :, 1]
+        work = np.stack((g0, g1), axis=1).reshape((2 * b, s // 2) + tail)
+        s //= 2
+    # up pass: butterfly combines with the depth-free pt2 twiddles
+    b, h = n, 1
+    vals = work
+    while h < n:
+        b2 = b // 2
+        w = vals.reshape((b2, 2, h) + tail)
+        u = w[:, 0]
+        v = w[:, 1]
+        w0 = u ^ _mul_const_np(pt2[:h], v)
+        w1 = w0 ^ v
+        vals = np.stack((w0, w1), axis=2).reshape((b2, 2 * h) + tail)
+        b, h = b2, 2 * h
+    return vals.reshape((n,) + tail)
+
+
+def gf_iafft(vals: np.ndarray, m: int) -> np.ndarray:
+    """Inverse additive FFT: gf_iafft(gf_afft(c, m), m) == c."""
+    _basis, _pts, pt2, _slot = _cantor_plan()
+    n = 1 << m
+    work = np.ascontiguousarray(vals, dtype=np.uint8)
+    if work.shape[0] != n:
+        raise ValueError(f"expected {n} values, got {work.shape[0]}")
+    tail = work.shape[1:]
+    work = work.reshape((1, n) + tail)
+    # down pass: butterfly inverses
+    b, h = 1, n
+    while h > 1:
+        w = work.reshape((b, h // 2, 2) + tail)
+        w0 = w[:, :, 0]
+        w1 = w[:, :, 1]
+        v = w0 ^ w1
+        u = w0 ^ _mul_const_np(pt2[: h // 2], v)
+        work = np.stack((u, v), axis=1).reshape((2 * b, h // 2) + tail)
+        b, h = 2 * b, h // 2
+    # up pass: merge (g0, g1) pairs + inverse Taylor shuffle
+    s = 1
+    while s < n:
+        b2 = work.shape[0] // 2
+        w = work.reshape((b2, 2, s) + tail)
+        g0 = w[:, 0]
+        g1 = w[:, 1]
+        merged = np.stack((g0, g1), axis=2).reshape((b2, 2 * s) + tail)
+        work = _itaylor_np(merged)
+        s *= 2
+    return work.reshape((n,) + tail)
+
+
+# ---------------------------------------------------------------------------
+# dispatch wrapper + lane accounting
+# ---------------------------------------------------------------------------
+
+
+def _note_lanes(n_lanes: int, real: int) -> None:
+    from ..obs.metrics import default_registry
+
+    reg = default_registry()
+    reg.gauge("ntt_batch_lanes").track(n_lanes)
+    reg.counter("ntt_pad_lanes").inc(max(0, n_lanes - real))
+    reg.counter("ntt_real_lanes").inc(real)
+
+
+def gf_afft_dispatch(
+    coeffs: np.ndarray, m: int, real_rows: int, device: bool
+) -> np.ndarray:
+    """One batched forward transform with lane accounting; routes to
+    the jitted twin when ``device`` (ops/rs_fft resolves the backend)
+    and to the numpy twin otherwise.  ``real_rows`` counts the
+    non-padding coefficient rows for the occupancy gauges."""
+    tail_lanes = int(np.prod(coeffs.shape[1:], dtype=np.int64)) or 1
+    _note_lanes((1 << m) * tail_lanes, real_rows * tail_lanes)
+    if device:
+        # the ONLY jax consumer of the plane, imported lazily: the
+        # host RS path must never load an accelerator runtime as a
+        # side effect of a routed encode (crypto/dkg._accel_mode
+        # discipline) — callers pass device=True only when jax is
+        # already live with a device backend
+        from ..obs import retrace as _retrace
+        from . import afft_T
+
+        _retrace.note("_afft_fwd_T", m, coeffs.shape[1:])
+        return np.asarray(afft_T._afft_fwd_T(coeffs, m))
+    return gf_afft(coeffs, m)

@@ -15,10 +15,12 @@ config-5 era switch (VERDICT r4 item 4 / next-round ask 4).
 Here ALL nodes' folds for one commitment run as one device program:
 lanes = (node m, output index), Horner over the matrix axis, where each
 step multiplies the accumulator by the lane's SMALL static evaluation
-point (node indices < 2^9) via masked double-and-add — the per-lane bit
-masks are trace-time constants, so a step is 9 doubles + 9 masked adds
-+ 1 chain add on [32, lanes] tiles, and the whole fold is ONE dispatch
-(a lax.scan of fused fq_T point kernels).
+point (node indices < 2^16 — the bound fold_points_batch asserts; real
+quorums sit well under 2^10) via masked double-and-add — the per-lane
+bit masks are trace-time constants and nbits tracks the widest index
+in the batch, so a step is nbits doubles + nbits masked adds + 1 chain
+add on [32, lanes] tiles, and the whole fold is ONE dispatch (a
+lax.scan of fused fq_T point kernels).
 
 Add-body choice (soundness against MALICIOUS proposers): the masked
 double-and-add steps use the incomplete 16-mul ladder body — their
@@ -35,6 +37,7 @@ inversion), so cached values are point-identical to the native fold.
 """
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import Sequence
 
@@ -50,8 +53,16 @@ from .fq_T import (
     jac_infinity_T,
 )
 
+# Compiled-fold cache size: one entry per distinct (t+1, #indices,
+# nbits, xs) geometry.  A steady sim holds one; a mixed-quorum-size
+# bench sweep (config 10 walks n = 16..512) holds one PER SIZE, and
+# the old maxsize=8 thrashed — every revisited size recompiled a
+# multi-second XLA trace.  32 covers every sweep in the repo;
+# HYDRABADGER_FOLD_CACHE overrides for exotic harnesses.
+_FOLD_CACHE_SIZE = int(os.environ.get("HYDRABADGER_FOLD_CACHE", "32"))
 
-@lru_cache(maxsize=8)
+
+@lru_cache(maxsize=_FOLD_CACHE_SIZE)
 def _fold_fn(J: int, K: int, M: int, nbits: int, xs_key: tuple):
     """Jitted fold over a [J, K] point matrix at M static points."""
     xs = np.asarray(xs_key, np.int64)

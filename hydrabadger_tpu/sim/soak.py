@@ -108,6 +108,8 @@ def sim_soak(epochs: int = 1000, n_nodes: int = 16,
         "max_deferred": max_deferred,
         "queue_peaks": peaks,
         "device_overlap_ratio": overlap["device_overlap_ratio"],
+        "device_overlap_ratio_raw": overlap["device_overlap_ratio_raw"],
+        "device_backend": overlap["device_backend"],
         "device_idle_s": overlap["device_idle_s"],
         "metrics": net.metrics.snapshot(),
         "agreement_ok": m.agreement_ok,
@@ -203,6 +205,8 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
             "rss_growth_mb": round(rss1 - rss0, 1),
             "queue_peaks": peaks,
             "device_overlap_ratio": overlap["device_overlap_ratio"],
+            "device_overlap_ratio_raw": overlap["device_overlap_ratio_raw"],
+            "device_backend": overlap["device_backend"],
             "device_idle_s": overlap["device_idle_s"],
             "metrics": merged,
         }

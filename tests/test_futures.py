@@ -140,10 +140,20 @@ def test_overlap_gauges_stamped():
     fut = futures.submit(lambda: 7, "g")
     assert fut.result() == 7
     snap = futures.overlap_snapshot()
-    assert 0.0 <= snap["device_overlap_ratio"] <= 1.0
+    # the raw ratio is always numeric; the headline field carries
+    # backend provenance — a CPU-only host must NOT report a
+    # misleading 0.0 as if the overlap plane regressed
+    assert 0.0 <= snap["device_overlap_ratio_raw"] <= 1.0
+    if snap["device_backend"] in ("tpu", "gpu"):
+        assert snap["device_overlap_ratio"] == snap[
+            "device_overlap_ratio_raw"
+        ]
+    else:
+        assert snap["device_overlap_ratio"] == "n/a (no device)"
     reg = default_registry()
     assert reg.gauge("device_overlap_ratio").value >= 0.0
     assert reg.gauge("device_idle_s").value >= 0.0
+    assert reg.gauge("device_overlap_has_device").value in (0, 1)
 
 
 # -- the per-tick MSM coalescer ---------------------------------------------

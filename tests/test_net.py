@@ -135,7 +135,9 @@ async def test_late_joiner_becomes_observer_then_validator():
                 for batch in nodes[0].batches
                 for v in batch.contributions.values()
             ),
-            timeout=30,
+            # 60s like the promotion wait above: the commit itself is
+            # fast, but a loaded host can stall the 4-node TCP cadence
+            timeout=60,
         )
         assert ok, "joiner's contribution never committed"
     finally:

@@ -1,0 +1,350 @@
+"""NTT/FFT transform plane tests (ops/ntt_T, ops/fr_poly, ops/rs_fft
+and the crypto/rs + crypto/dkg routing).
+
+The plane's hard contract is IDENTITY: every routed path must emit the
+exact residues/bytes of the reference it replaces (matrix encode,
+Horner evaluation, quadratic Lagrange), because every node in a quorum
+must derive identical values regardless of route or host.  These tests
+pin that across every geometry tier 1 exercises, plus the transform-
+level properties (forward∘inverse round-trips, naive-evaluation
+equality, jax-twin equality) and the threshold crossover itself.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from hydrabadger_tpu.crypto import gf256
+from hydrabadger_tpu.crypto.bls12_381 import R
+from hydrabadger_tpu.crypto.rs import ReedSolomon, encode_matrix
+from hydrabadger_tpu.crypto.threshold import (
+    poly_eval,
+    poly_interpolate_at_zero,
+)
+from hydrabadger_tpu.ops import fr_poly, ntt_T, rs_fft
+
+# every (data, parity) geometry exercised elsewhere in tier 1
+TIER1_SHAPES = [
+    (1, 1), (2, 1), (3, 2), (4, 2), (4, 3),
+    (16, 8), (22, 42), (42, 21), (170, 85),
+]
+
+
+# -- Fr radix-2/4 NTT --------------------------------------------------------
+
+
+def test_fr_ntt_roundtrip():
+    rnd = random.Random(1)
+    for n in (1, 2, 4, 8, 32, 128, 512):
+        v = [rnd.randrange(R) for _ in range(n)]
+        assert fr_poly.fr_intt(fr_poly.fr_ntt(v)) == v
+
+
+def test_fr_ntt_matches_naive_dft():
+    rnd = random.Random(2)
+    for n in (2, 4, 8, 16):  # covers radix-2, radix-4 and mixed stages
+        v = [rnd.randrange(R) for _ in range(n)]
+        w = pow(fr_poly.FR_ROOT_OF_UNITY, (1 << 32) // n, R)
+        naive = [
+            sum(v[j] * pow(w, j * k, R) for j in range(n)) % R
+            for k in range(n)
+        ]
+        assert fr_poly.fr_ntt(v) == naive
+
+
+def test_fr_ntt_rejects_bad_sizes():
+    with pytest.raises(ValueError):
+        fr_poly.fr_ntt([1, 2, 3])
+
+
+def test_fr_poly_mul_matches_schoolbook():
+    rnd = random.Random(3)
+    a = [rnd.randrange(R) for _ in range(37)]
+    b = [rnd.randrange(R) for _ in range(55)]
+    out = [0] * (len(a) + len(b) - 1)
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            out[i + j] = (out[i + j] + x * y) % R
+    assert fr_poly.fr_poly_mul(a, b) == out
+    # public surface re-exported by the plane module
+    assert ntt_T.fr_poly_mul(a, b) == out
+
+
+# -- Fr multipoint evaluation / interpolation --------------------------------
+
+
+def test_fr_eval_many_matches_horner():
+    rnd = random.Random(4)
+    for n, t in [(8, 2), (37, 12), (64, 21), (130, 43)]:
+        row = [rnd.randrange(R) for _ in range(t + 1)]
+        xs = list(range(1, n + 1))
+        want = [poly_eval(row, x) for x in xs]
+        assert fr_poly.eval_many([row], xs)[0] == want
+    # non-consecutive points take the Horner path, same residues
+    row = [rnd.randrange(R) for _ in range(13)]
+    xs = [1, 3, 7, 20, 21]
+    assert fr_poly.eval_many([row], xs)[0] == [
+        poly_eval(row, x) for x in xs
+    ]
+
+
+def test_fr_eval_many_batch_rows():
+    rnd = random.Random(5)
+    rows = [
+        [rnd.randrange(R) for _ in range(22)] for _ in range(3)
+    ]
+    xs = list(range(1, 65))
+    got = fr_poly.eval_many(rows, xs)
+    for row, vals in zip(rows, got):
+        assert vals == [poly_eval(row, x) for x in xs]
+
+
+def test_fr_interpolate_at_zero_consecutive_and_gapped():
+    rnd = random.Random(6)
+    for t in (1, 5, 21, 66):
+        coeffs = [rnd.randrange(R) for _ in range(t + 1)]
+        pts = {x: poly_eval(coeffs, x) for x in range(2, t + 3)}
+        assert (
+            fr_poly.interpolate_at_zero(pts)
+            == poly_interpolate_at_zero(pts)
+            == coeffs[0]
+        )
+    coeffs = [rnd.randrange(R) for _ in range(5)]
+    gapped = {x: poly_eval(coeffs, x) for x in (1, 2, 5, 9, 11)}
+    assert fr_poly.interpolate_at_zero(gapped) == poly_interpolate_at_zero(
+        gapped
+    )
+
+
+# -- GF(256) additive (Cantor) FFT -------------------------------------------
+
+
+def test_cantor_basis_well_formed():
+    basis = ntt_T._cantor_plan()[0]
+    assert basis[0] == 1
+    for lo, hi in zip(basis, basis[1:]):
+        assert int(gf256.mul(hi, hi)) ^ hi == lo  # v_{i+1}^2+v_{i+1}=v_i
+    assert len(set(int(p) for p in ntt_T.afft_points())) == 256
+
+
+def test_afft_roundtrip_and_naive_eval():
+    rng = np.random.default_rng(0)
+    pts = ntt_T.afft_points()
+    for m in (0, 1, 3, 5, 8):
+        n = 1 << m
+        c = rng.integers(0, 256, (n, 3)).astype(np.uint8)
+        ev = ntt_T.gf_afft(c, m)
+        assert np.array_equal(ntt_T.gf_iafft(ev, m), c)
+        for j in (0, n // 2, n - 1):
+            x = int(pts[j])
+            acc = np.zeros(3, np.uint8)
+            xp = 1
+            for i in range(n):
+                acc ^= gf256.mul(c[i], xp)
+                xp = int(gf256.MUL_TABLE[xp, x])
+            assert np.array_equal(acc, ev[j]), (m, j)
+
+
+def test_afft_jax_twin_matches_numpy():
+    # the jitted twins live in ops/afft_T (the plane's only jax
+    # dependency, loaded lazily by gf_afft_dispatch's device branch)
+    from hydrabadger_tpu.ops import afft_T
+
+    rng = np.random.default_rng(1)
+    for m in (1, 4, 8):
+        n = 1 << m
+        c = rng.integers(0, 256, (n, 5)).astype(np.uint8)
+        fwd = np.asarray(afft_T._afft_fwd_T(c, m))
+        assert np.array_equal(fwd, ntt_T.gf_afft(c, m))
+        assert np.array_equal(np.asarray(afft_T._afft_inv_T(fwd, m)), c)
+
+
+# -- RS via the FFT plane: byte identity with the matrix path ----------------
+
+
+@pytest.mark.parametrize("k,p", TIER1_SHAPES)
+def test_rs_fft_encode_identical_to_matrix(k, p):
+    rng = np.random.default_rng(k * 1000 + p)
+    mat = np.asarray(encode_matrix(k, p))
+    data = rng.integers(0, 256, (k, 9)).astype(np.uint8)
+    want = gf256.matmul(mat[k:], data)
+    assert np.array_equal(rs_fft.encode_parity(data, k, p), want)
+
+
+@pytest.mark.parametrize("k,p", [(4, 2), (16, 8), (42, 21), (170, 85)])
+def test_rs_fft_reconstruct_identical_to_matrix(k, p):
+    rng = np.random.default_rng(k)
+    n = k + p
+    mat = np.asarray(encode_matrix(k, p))
+    data = rng.integers(0, 256, (k, 5)).astype(np.uint8)
+    full = np.concatenate([data, gf256.matmul(mat[k:], data)], axis=0)
+    killed = sorted(
+        int(x) for x in rng.choice(n, size=min(p, 3), replace=False)
+    )
+    present = [i for i in range(n) if i not in killed][:k]
+    rec = rs_fft.reconstruct_rows(full[present], present, killed, k, p)
+    assert np.array_equal(rec, full[killed])
+
+
+def test_rs_fft_batch_encode():
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (4, 16, 12)).astype(np.uint8)
+    out = rs_fft.encode_batch(data, 16, 8)
+    rs = ReedSolomon(16, 8)
+    for b in range(4):
+        assert np.array_equal(out[b], rs.encode(data[b]))
+
+
+# -- routing: crypto/rs threshold + crossover --------------------------------
+
+
+def _roundtrip(rs: ReedSolomon, payload: bytes) -> list:
+    shards = rs.encode_bytes(payload)
+    holes = [
+        s if i not in (0, rs.total_shards - 1) else None
+        for i, s in enumerate(shards)
+    ]
+    assert rs.reconstruct_data(holes) == payload
+    return shards
+
+
+def test_rs_routing_crossover_identical(monkeypatch):
+    """Both routes emit identical shards AT the switch point: n = 6
+    sits on the threshold with the FFT route, one below it with the
+    matrix route — same bytes either way (and the kill switch pins
+    the matrix path at any n)."""
+    payload = b"crossover pinning payload " * 5
+    monkeypatch.setenv("HYDRABADGER_NTT_MIN_SHARDS", "6")
+    fft_shards = _roundtrip(ReedSolomon(4, 2), payload)
+    monkeypatch.setenv("HYDRABADGER_NTT_MIN_SHARDS", "7")
+    matrix_shards = _roundtrip(ReedSolomon(4, 2), payload)
+    assert fft_shards == matrix_shards
+    monkeypatch.setenv("HYDRABADGER_NTT_MIN_SHARDS", "6")
+    monkeypatch.setenv("HYDRABADGER_NTT", "0")  # the pinned fallback
+    assert _roundtrip(ReedSolomon(4, 2), payload) == matrix_shards
+
+
+def test_rs_routed_verify_and_parity_reconstruct(monkeypatch):
+    monkeypatch.setenv("HYDRABADGER_NTT_MIN_SHARDS", "5")
+    rs = ReedSolomon(3, 2)
+    data = np.arange(30, dtype=np.uint8).reshape(3, 10)
+    full = rs.encode(data)
+    assert rs.verify(list(full))
+    # parity AND data holes: the FFT branch refills both
+    holes = [full[i] if i not in (1, 4) else None for i in range(5)]
+    restored = rs.reconstruct(holes)
+    for i in range(5):
+        assert np.array_equal(restored[i], full[i])
+    corrupted = [np.array(s) for s in full]
+    corrupted[4][0] ^= 1
+    assert not rs.verify(corrupted)
+
+
+# -- routing: DKG era identity -----------------------------------------------
+
+
+def _run_dkg_era(n=5, threshold=1, seed=11):
+    from hydrabadger_tpu.crypto import dkg
+
+    rng = random.Random(seed)
+    sks = [dkg.SecretKey.random(rng) for _ in range(n)]
+    pks = {i: sks[i].public_key() for i in range(n)}
+    kgs = [
+        dkg.SyncKeyGen(
+            i, sks[i], pks, threshold=threshold, rng=random.Random(seed + i)
+        )
+        for i in range(n)
+    ]
+    parts = [kg.propose() for kg in kgs]
+    acks = {}
+    for s, part in enumerate(parts):
+        for i, kg in enumerate(kgs):
+            out = kg.handle_part(s, part)
+            assert out.valid, out.fault
+            acks[(s, i)] = out.ack
+    for (s, i), ack in acks.items():
+        for kg in kgs:
+            res = kg.handle_ack(i, ack)
+            assert res.valid, res.fault
+    outs = [kg.generate() for kg in kgs]
+    return (
+        [p.commit_bytes for p in parts],
+        [p.enc_rows for p in parts],
+        [(pk.to_bytes(), share.scalar) for pk, share in outs],
+    )
+
+
+def test_dkg_era_identical_across_routes(monkeypatch):
+    """A full DKG era with the NTT route forced on (threshold 4) is
+    bit-identical — parts, sealed rows, public key set, share scalars
+    — to the Horner-pinned era."""
+    monkeypatch.setenv("HYDRABADGER_NTT", "0")
+    ref = _run_dkg_era()
+    monkeypatch.delenv("HYDRABADGER_NTT")
+    monkeypatch.setenv("HYDRABADGER_NTT_MIN_N", "4")
+    routed = _run_dkg_era()
+    assert ref == routed
+
+
+def test_bivar_rows_batch_matches_row(monkeypatch):
+    from hydrabadger_tpu.crypto import dkg
+
+    monkeypatch.setenv("HYDRABADGER_NTT_MIN_N", "4")
+    poly = dkg.BivarPoly.random(3, random.Random(8))
+    xs = list(range(1, 10))
+    rows = poly.rows_batch(xs)
+    for x, row in zip(xs, rows):
+        assert row == poly.row(x)
+
+
+# -- engine entrypoints ------------------------------------------------------
+
+
+def test_engine_fr_poly_eval_batch_and_submit():
+    from hydrabadger_tpu.crypto.engine import get_engine
+
+    rnd = random.Random(9)
+    rows = [[rnd.randrange(R) for _ in range(4)] for _ in range(2)]
+    xs = [1, 2, 3, 4, 5]
+    want = [[poly_eval(r, x) for x in xs] for r in rows]
+    for spec in ("cpu", "tpu"):
+        eng = get_engine(spec)
+        assert eng.fr_poly_eval_batch(rows, xs) == want
+        fut = eng.submit_fr_poly_eval_batch(rows, xs)
+        assert fut.result() == want
+
+
+def test_tpu_engine_rs_batch_routes_identically(monkeypatch):
+    from hydrabadger_tpu.crypto.engine import get_engine
+
+    eng = get_engine("tpu")
+    rng = np.random.default_rng(10)
+    data = rng.integers(0, 256, (3, 4, 8)).astype(np.uint8)
+    monkeypatch.setenv("HYDRABADGER_NTT_MIN_SHARDS", "6")
+    routed = eng.rs_encode_batch(data, 4, 2)
+    monkeypatch.setenv("HYDRABADGER_NTT", "0")
+    baseline = eng.rs_encode_batch(data, 4, 2)
+    assert np.array_equal(routed, baseline)
+    monkeypatch.delenv("HYDRABADGER_NTT")
+    rec = eng.rs_reconstruct_batch(
+        routed[:, [0, 2, 4, 5]], [0, 2, 4, 5], 4, 2
+    )
+    assert np.array_equal(rec, data)
+    fut = eng.submit_rs_encode_batch(data, 4, 2)
+    assert np.array_equal(fut.result(), baseline)
+
+
+# -- lane-occupancy gauges ---------------------------------------------------
+
+
+def test_ntt_lane_gauges_stamped():
+    from hydrabadger_tpu.obs.metrics import default_registry
+
+    reg = default_registry()
+    before = reg.counter("ntt_real_lanes").value
+    rng = np.random.default_rng(11)
+    rs_fft.encode_parity(
+        rng.integers(0, 256, (42, 4)).astype(np.uint8), 42, 21
+    )
+    assert reg.counter("ntt_real_lanes").value > before
+    assert reg.gauge("ntt_batch_lanes").value >= 256

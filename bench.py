@@ -949,6 +949,101 @@ def _ntt_crossover_config10() -> dict:
     }
 
 
+def _byz_liveness_config11(epochs: int = 20) -> dict:
+    """Round-7 Byzantine scenario row (ROADMAP item 5): liveness under
+    attack as a first-class bench metric.
+
+    Two topologies, each run honest-only and then with the last ``f``
+    nodes running the full attack catalog (equivocating RBC senders,
+    withheld + garbage G1 decryption shares through the complete-add
+    verify plane, replay floods) at the full-crypto sim tier
+    (encrypt + verify_shares — garbage shares MUST travel the batched
+    pairing verify).  Asserts the honest quorum commits every epoch in
+    agreement at >= 0.5x the honest rate, and that every injected
+    fault kind surfaced through the fault-observability contract
+    (sim/scenario.py FAULT_OBSERVABLES) — a silent tolerance fails the
+    row.  ``value`` is the attacked 4-node committed-epochs/s;
+    ``vs_baseline`` its ratio against the honest-only twin."""
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+    from hydrabadger_tpu.sim.scenario import attack_spec
+
+    def leg(n_nodes, n_epochs, spec):
+        """One timed leg: a 1-epoch warmup is excluded from the rate
+        (the first leg of a fresh process would otherwise pay the
+        one-time jit/codec cold-start alone and skew the ratio), and
+        the network is settled so a dropped CryptoFuture can never be
+        misattributed to a LATER leg by the process-global ledger."""
+        net = SimNetwork(
+            SimConfig(
+                n_nodes=n_nodes, protocol="qhb", encrypt=True,
+                verify_shares=True, seed=23, scenario=spec,
+            )
+        )
+        net.run(1)
+        warm_wall = net.total_wall_s
+        m = net.run(n_epochs)
+        assert m.agreement_ok, f"agreement lost at {n_nodes} nodes"
+        assert m.epochs_done == n_epochs + 1, (
+            f"liveness lost at {n_nodes} nodes: {m.epochs_done}"
+        )
+        eps = n_epochs / (net.total_wall_s - warm_wall)
+        if spec is not None:
+            net.verify_scenario()  # every kind observed, or raise
+        net.shutdown()
+        return net, eps
+
+    rows = []
+    for n_nodes, n_epochs in ((4, epochs), (16, max(4, epochs // 4))):
+        f = (n_nodes - 1) // 3
+        _h, honest_eps = leg(n_nodes, n_epochs, None)
+        net, attacked_eps = leg(
+            n_nodes, n_epochs, attack_spec(n_nodes, seed=23)
+        )
+        ratio = attacked_eps / honest_eps
+        # the acceptance 2x bound is asserted on the 4-node headline
+        # (20+ epochs: stable); the 16-node leg times only a few
+        # full-crypto epochs, so it gets a sanity floor rather than a
+        # hair-trigger that could abort a whole --all sweep on one
+        # scheduler stall — the measured ratio is in the artifact
+        # either way, and the SOAK tier asserts the bound over
+        # hundreds of epochs
+        floor = 0.5 if n_nodes == 4 else 0.3
+        assert ratio >= floor, (
+            f"attacked rate fell below {floor}x honest at {n_nodes} "
+            f"nodes: {ratio:.2f}x"
+        )
+        counters = net.metrics.snapshot()["counters"]
+        rows.append(
+            {
+                "n_nodes": n_nodes,
+                "n_byzantine": f,
+                "epochs": n_epochs,
+                "honest_epochs_per_sec": round(honest_eps, 3),
+                "attacked_epochs_per_sec": round(attacked_eps, 3),
+                "vs_honest": round(ratio, 3),
+                "byz_injected": dict(net.scenario_log.counts),
+                "byz_faults": {
+                    k: v for k, v in sorted(counters.items())
+                    if k.startswith("byz_faults_")
+                },
+            }
+        )
+    return {
+        "metric": "byz_liveness_epochs_per_sec_4node_f1_full_crypto",
+        "value": rows[0]["attacked_epochs_per_sec"],
+        "unit": "epochs/s",
+        "vs_baseline": rows[0]["vs_honest"],
+        "topologies": rows,
+        "note": (
+            "honest quorum committed-epochs/s with f Byzantine nodes "
+            "running equivocate+withhold+garbage_shares+replay_flood, "
+            "vs the honest-only twin at the same config; observability "
+            "contract verified (every injected kind surfaced as a "
+            "fault_log entry or byz_faults_* counter)"
+        ),
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -956,7 +1051,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
@@ -967,7 +1062,9 @@ def main(argv=None) -> int:
         "lanes vs native C++ per-share), 8 = full-crypto epochs/s, "
         "9 = batched-MSM plane micro-row (ops/msm_T vs native Pippenger), "
         "10 = NTT-plane crossover sweep (RS encode + DKG poly-eval, "
-        "n = 16..768, matrix/Horner vs FFT routes)",
+        "n = 16..768, matrix/Horner vs FFT routes), 11 = Byzantine "
+        "liveness-under-attack (4/16-node full-crypto sim, f attacking "
+        "nodes vs the honest twin)",
     )
     p.add_argument(
         "--epochs",
@@ -1051,6 +1148,10 @@ def main(argv=None) -> int:
             # exact host/numpy arithmetic; no accelerator required)
             ("config10_ntt_crossover", _ntt_crossover_config10,
              "always"),
+            # liveness-under-attack: full-crypto CPU sim either way (the
+            # scenario plane disables the native fast path by design)
+            ("config11_byz_liveness",
+             lambda: _byz_liveness_config11(epochs_or(20)), "always"),
         ]
         jax_ok = not probe.get("error")
         backend_lost = False
@@ -1177,6 +1278,8 @@ def main(argv=None) -> int:
         return single(_msm_batch_microrow)
     if args.config == 10:
         return single(_ntt_crossover_config10)
+    if args.config == 11:
+        return single(lambda: _byz_liveness_config11(epochs_or(20)))
 
     # config 3 (also the fall-through for the bare invocation)
     return single(_rs_throughput_config3)

@@ -272,6 +272,17 @@ def load_node(path: str) -> NodeCheckpoint:
 
 def sim_to_bytes(sim) -> bytes:
     """Serialize a SimNetwork with adversary callables stripped."""
+    if getattr(sim.cfg, "scenario", None) is not None:
+        # A scenario run keeps cfg.adversary None and holds the compiled
+        # ScenarioAdversary on the router, so the had_adversary flag
+        # below would record False and a resume would silently strip the
+        # link adversary while the pickled ByzantineNode wrappers kept
+        # attacking — an incoherent half-attacked network.
+        raise CheckpointError(
+            "cannot checkpoint a sim running a ScenarioSpec; scenario "
+            "runs compile node wrappers at construction time and cannot "
+            "be resumed coherently"
+        )
     cfg_adv, router_adv = sim.cfg.adversary, sim.router.adversary
     sim.cfg.adversary = sim.router.adversary = None
     try:

@@ -50,6 +50,7 @@ class Broadcast:
         self.echos: Dict = {}  # sender -> Proof
         self.readys: Dict = {}  # sender -> root bytes
         self.fault_estimate = 0
+        self._mixed_roots_flagged = False
 
     def __setstate__(self, state):
         """Unpickle (sim checkpoint resume): recorder fields postdate
@@ -57,6 +58,7 @@ class Broadcast:
         self.__dict__.update(state)
         self.__dict__.setdefault("obs", _resolve_recorder(None))
         self.__dict__.setdefault("_span_open", True)
+        self.__dict__.setdefault("_mixed_roots_flagged", False)
 
     # -- API ----------------------------------------------------------------
 
@@ -137,6 +139,24 @@ class Broadcast:
         step = Step()
         n, f = self.netinfo.num_nodes, self.netinfo.num_faulty
         root = proof.root
+        # Distinct validated roots within one instance mean SOMEBODY
+        # misbehaved: either the proposer disseminated shards of two
+        # different codings (split-root equivocation), or an echoer
+        # fabricated a whole alternative tree.  Either way the instance
+        # can stall without any per-message check firing — log it once
+        # so an equivocating proposer is never SILENTLY tolerated.  The
+        # fault names the proposer (the overwhelmingly likely author)
+        # but the kind records the attribution ambiguity.
+        if not self._mixed_roots_flagged and any(
+            p.root != root for p in self.echos.values()
+        ):
+            self._mixed_roots_flagged = True
+            self.obs.instant("rbc_mixed_roots")
+            step.fault(
+                self.proposer_id,
+                "broadcast: mixed echo roots (proposer equivocation "
+                "or forged echo)",
+            )
         if self._count_echos(root) >= n - f and not self.ready_sent:
             step.extend(self._send_ready(root))
         if (

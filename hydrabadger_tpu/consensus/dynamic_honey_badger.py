@@ -491,6 +491,17 @@ class DynamicHoneyBadger:
             contributions[proposer] = bytes(user)
             for vote in votes:
                 batch_votes.append((proposer, vote))
+            # Per-contribution keygen-message cap: an honest node ships
+            # at most its own part plus one ack per peer per batch (and
+            # retransmits until seen committed), so n(n+2) bounds every
+            # legitimate backlog.  A Byzantine proposer stuffing more
+            # into one contribution is a flood — fault it and truncate,
+            # so one committed contribution cannot drive an unbounded
+            # handle_part/handle_ack storm.
+            kg_cap = self.netinfo.num_nodes * (self.netinfo.num_nodes + 2)
+            if len(kg_msgs) > kg_cap:
+                step.fault(proposer, "dhb: keygen message flood")
+                kg_msgs = kg_msgs[:kg_cap]
             for kg in kg_msgs:
                 if proposer == self.our_id:
                     # our own keygen msg committed: stop retransmitting it

@@ -75,6 +75,14 @@ class ThresholdDecrypt:
         except ValueError:
             return Step().fault(sender, "threshold_decrypt: bad share bytes")
         if self.ciphertext is None:
+            prior = self.pending.get(sender)
+            if prior is not None and prior.to_bytes() != share.to_bytes():
+                # pre-ciphertext equivocation: keep the first share so
+                # the overwrite can't launder the conflict past the
+                # quorum-time check in _handle_share
+                return Step().fault(
+                    sender, "threshold_decrypt: conflicting share"
+                )
             self.pending[sender] = share
             return Step()
         return self._handle_share(sender, share)
@@ -91,7 +99,16 @@ class ThresholdDecrypt:
         2-pairing test (engine.verify_decryption_shares_batch), with a
         per-share fallback attributing faults to exactly the same
         senders the eager path would have flagged."""
-        if self.terminated or sender in self.shares:
+        if self.terminated:
+            return Step()
+        if sender in self.shares:
+            # a second, DIFFERENT share from the same sender is
+            # equivocation (a duplicate of the first is routine replay
+            # noise and stays silent)
+            if self.shares[sender].to_bytes() != share.to_bytes():
+                return Step().fault(
+                    sender, "threshold_decrypt: conflicting share"
+                )
             return Step()
         idx = self.netinfo.index(sender)
         if idx is None:

@@ -58,6 +58,43 @@ class Fault(Generic[N]):
     kind: str
 
 
+# -- Byzantine fault-kind taxonomy ------------------------------------------
+#
+# Canonical tokens naming each injectable fault class of the adversarial
+# scenario plane (sim/scenario.py, sim/byzantine.py).  The cores'
+# fault_log kinds stay free-form protocol strings ("broadcast: ...");
+# these tokens name the INJECTION side, and the scenario verifier
+# (sim/scenario.py:FAULT_OBSERVABLES) maps every token to the observable
+# — a fault_log substring, a ``byz_faults_*`` counter, or a declared
+# queue high-water — that proves the system noticed or absorbed it.
+# A token injected without a registered observable is a test failure:
+# silent tolerance is indistinguishable from silent corruption.
+
+BYZ_EQUIVOCATION = "equivocation"  # conflicting RBC Value/Echo to disjoint sets
+BYZ_GARBAGE_SHARE = "garbage_share"  # attacker-chosen G1 point as a tdec share
+BYZ_WITHHELD_SHARE = "withheld_share"  # own decryption share never sent
+BYZ_DKG_CORRUPT = "dkg_corrupt"  # malformed Part/Ack in committed contributions
+BYZ_REPLAY_FLOOD = "replay_flood"  # other senders' frames replayed as our own
+BYZ_LINK_DROP = "link_drop"  # per-link loss (breaks the reliable-delivery model)
+BYZ_LINK_DUP = "link_dup"  # per-link duplication
+BYZ_LINK_DELAY = "link_delay"  # per-link hold/reorder
+BYZ_PARTITION = "partition"  # cross-group traffic held until heal
+
+BYZ_KINDS = frozenset(
+    {
+        BYZ_EQUIVOCATION,
+        BYZ_GARBAGE_SHARE,
+        BYZ_WITHHELD_SHARE,
+        BYZ_DKG_CORRUPT,
+        BYZ_REPLAY_FLOOD,
+        BYZ_LINK_DROP,
+        BYZ_LINK_DUP,
+        BYZ_LINK_DELAY,
+        BYZ_PARTITION,
+    }
+)
+
+
 @dataclass
 class Step(Generic[N]):
     """The sole output channel of a protocol core."""

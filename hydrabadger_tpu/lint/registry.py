@@ -61,6 +61,13 @@ TAINT_SOURCE_ATTRS = frozenset({"payload", "enc_rows", "enc_values", "commit_byt
 TAINT_SOURCE_PARAMS = frozenset(
     {
         ("sim/router.py", "_enqueue", "message"),
+        # Byzantine scenario plane: the fault-injection hook sees every
+        # routed frame, and a ByzantineNode's inbound deliveries are the
+        # raw material its strategies replay/corrupt — both are
+        # adversary-controlled end to end
+        ("sim/scenario.py", "inject", "message"),
+        ("sim/byzantine.py", "handle_message", "message"),
+        ("sim/byzantine.py", "on_receive", "message"),
         ("net/node.py", "_on_net_state", "net_state"),
         ("net/node.py", "_on_join_plan", "payload"),
         ("net/node.py", "_on_era_transcript", "payload"),

@@ -173,6 +173,67 @@ def sample_messages(wire_module=None):
     return [wire_module.WireMessage(k, samples[k]) for k in sorted(declared)]
 
 
+def _uvarint(n: int) -> bytes:
+    # thin wrapper over the REAL wire encoder so forged counts can never
+    # drift from the encoding decode actually parses
+    from ..utils.codec import _write_uvarint
+
+    out = bytearray()
+    _write_uvarint(out, n)
+    return bytes(out)
+
+
+def malformed_samples(wire_module=None):
+    """The adversarial twin of :func:`sample_messages`: a corpus of
+    malformed frame bodies, every one of which ``WireMessage.decode``
+    must reject with ValueError — never any other exception type
+    (the read loops' fault path catches exactly that; anything else
+    escapes and kills the task, a remote-triggered crash).
+
+    Derived from the honest corpus so it tracks KINDS automatically:
+    truncations of every variant, forged list/dict element counts
+    (including a count spliced over a real frame's), unknown and
+    non-string kinds, wrong-arity and non-sequence bodies, and a
+    nesting bomb.  Returns ``[(label, raw_bytes), ...]``."""
+    from ..utils import codec
+
+    samples = sample_messages(wire_module)
+    out = []
+    for msg in samples:
+        raw = msg.encode()
+        # truncated payloads: the frame cut at the tag boundary, a
+        # quarter of the way in, one byte short, and mid-varint
+        for cut in sorted({1, 2, len(raw) // 4, len(raw) // 2, len(raw) - 1}):
+            if 0 < cut < len(raw):
+                out.append((f"{msg.kind}:cut@{cut}", raw[:cut]))
+        # trailing garbage after a complete frame
+        out.append((f"{msg.kind}:trailing", raw + b"\x00"))
+    # forged collection counts: headers claiming more elements than the
+    # remaining bytes could hold, bare and spliced over a real frame
+    real = samples[0].encode()
+    out += [
+        ("forged:list_2^60", b"L" + _uvarint(1 << 60)),
+        ("forged:dict_2^60", b"D" + _uvarint(1 << 60)),
+        ("forged:list_2^60_with_elems", b"L" + _uvarint(1 << 60) + b"N" * 64),
+        ("forged:count_over_frame", b"L" + _uvarint(1 << 32) + real[2:]),
+        ("forged:pair_count", b"L" + _uvarint(200) + real[2:]),
+    ]
+    # kind-level malformations
+    out += [
+        ("kind:unknown", codec.encode(("no_such_kind", None))),
+        ("kind:nonstring", codec.encode((42, None))),
+        ("kind:bytes", codec.encode((b"message", None))),
+        ("body:not_a_pair", codec.encode(None)),
+        ("body:int", codec.encode(7)),
+        ("body:1tuple", codec.encode(("message",))),
+        ("body:3tuple", codec.encode(("message", None, None))),
+        ("body:empty", b""),
+        ("body:unknown_tag", b"Z"),
+        ("body:nesting_bomb", b"L\x01" * 600 + b"N"),
+    ]
+    return out
+
+
 # -- the static rule ---------------------------------------------------------
 
 

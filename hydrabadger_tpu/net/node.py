@@ -1592,6 +1592,40 @@ class Hydrabadger:
                         WIRE_RETRY_CAP,
                     )
 
+    def _replay_due(self, now: float) -> bool:
+        """The replay-pacing gate, factored out of the loop so the
+        backoff schedule is unit-testable against a synthetic clock.
+
+        Adaptive stall threshold (r4 soak post-mortem): "stalled" means
+        no progress for clearly longer than this node's own recent
+        epoch duration — a fixed 1 s threshold misfires on every
+        full-crypto epoch and the replay traffic itself (a signature
+        verify per frame per receiver) then starves consensus.
+
+        Back off on time since the LAST REPLAY, not since last progress
+        (ADVICE r5): with the old gate, once a genuinely wedged epoch
+        stalled past backoff_cap x threshold the elapsed-since-progress
+        term exceeded it on every tick and the node reverted to one
+        full outbox replay per second — the flood the backoff was meant
+        to bound.  Inter-replay spacing doubles up to 16x regardless of
+        stall age; suppressed ticks are counted so a flood held back by
+        the gate is still observable (``epoch_replays_suppressed``).
+
+        Returns True — and advances the backoff state — when a replay
+        should fire now."""
+        ema = self._epoch_ema_s or EPOCH_REPLAY_TICK_S
+        threshold = max(3.0 * ema, 2.0 * EPOCH_REPLAY_TICK_S)
+        if now - self._last_progress_t < threshold:
+            return False
+        if now - self._last_replay_t < threshold * self._replay_backoff:
+            self.metrics.counter("epoch_replays_suppressed").inc()
+            return False
+        self._replay_backoff = min(self._replay_backoff * 2.0, 16.0)
+        self._last_replay_t = now
+        self._replayed_since_progress = True
+        self.metrics.counter("epoch_replays").inc()
+        return True
+
     async def _epoch_replay_loop(self) -> None:
         """Liveness net for in-flight frame loss: a frame can die in a
         closed socket's buffers on EITHER side of a duplicate-connection
@@ -1608,37 +1642,14 @@ class Hydrabadger:
             if len(self.batches) != self._last_progress_batches:
                 self._last_progress_batches = len(self.batches)
                 continue
-            # Adaptive stall threshold (r4 soak post-mortem): "stalled"
-            # means no progress for clearly longer than this node's own
-            # recent epoch duration — a fixed 1 s threshold misfires on
-            # every full-crypto epoch and the replay traffic itself
-            # (a signature verify per frame per receiver) then starves
-            # consensus.  Exponential backoff while still stalled keeps
-            # a genuinely wedged epoch from flooding the wire either.
-            ema = self._epoch_ema_s or EPOCH_REPLAY_TICK_S
-            threshold = max(3.0 * ema, 2.0 * EPOCH_REPLAY_TICK_S)
-            now = _time.monotonic()
-            if now - self._last_progress_t < threshold:
+            if not self._replay_due(_time.monotonic()):
                 continue
-            # Back off on time since the LAST REPLAY, not since last
-            # progress (ADVICE r5): with the old gate, once a genuinely
-            # wedged epoch stalled past backoff_cap x threshold the
-            # elapsed-since-progress term exceeded it on every tick and
-            # the node reverted to one full outbox replay per second —
-            # the flood the backoff was meant to bound.  Inter-replay
-            # spacing doubles up to 16x regardless of stall age.
-            if now - self._last_replay_t < threshold * self._replay_backoff:
-                continue
-            self._replay_backoff = min(self._replay_backoff * 2.0, 16.0)
-            self._last_replay_t = now
-            self._replayed_since_progress = True
-            self.metrics.counter("epoch_replays").inc()
             frames = list(self._epoch_outbox)
             log.debug(
                 "%s epoch stalled %.1fs (ema %.1fs): replaying %d frames",
                 self.uid,
                 _time.monotonic() - self._last_progress_t,
-                ema,
+                self._epoch_ema_s or EPOCH_REPLAY_TICK_S,
                 len(frames),
             )
             for _epoch, target, msg in frames:

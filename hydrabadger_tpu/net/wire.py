@@ -67,8 +67,24 @@ class WireMessage:
 
     @classmethod
     def decode(cls, raw: bytes) -> "WireMessage":
-        kind, payload = codec.decode(raw)
-        if kind not in KINDS:
+        """Decode one frame body.  Raises ValueError — and ONLY
+        ValueError — on every malformed input (truncation, forged
+        collection counts, wrong arity, non-sequence bodies, unknown
+        kinds), so the read loops' fault path is the single exit for
+        adversarial bytes (pinned by the lint/wire_contract
+        malformed_samples fuzz corpus in tests/test_codec.py)."""
+        body = codec.decode(raw)
+        if not isinstance(body, tuple) or len(body) != 2:
+            # a valid codec value of the wrong SHAPE (an int — or a
+            # 2-key dict, whose iteration would unpack into its KEYS —
+            # where the (kind, payload) pair belongs) is as malformed
+            # as a bad byte — reject it on the same fault path
+            raise ValueError(
+                f"malformed wire frame: body is {type(body).__name__}, "
+                "not a (kind, payload) pair"
+            )
+        kind, payload = body
+        if not isinstance(kind, str) or kind not in KINDS:
             raise ValueError(f"unknown wire kind {kind!r}")
         return cls(kind, payload)
 

@@ -39,6 +39,23 @@ DEVICE_OVERLAP_RATIO = "device_overlap_ratio"
 DEVICE_IDLE_S = "device_idle_s"
 DEVICE_OVERLAP_HAS_DEVICE = "device_overlap_has_device"
 
+# Byzantine scenario plane (sim/scenario.py) counter families.  Both
+# prefixes are suffixed by a consensus/types.py BYZ_* taxonomy token, so
+# the registry's size stays bounded by the fixed taxonomy even when the
+# injection VOLUME is attacker-paced:
+#
+#   BYZ_INJECTED_PREFIX — what the scenario plane DID (one count per
+#       injected fault, stamped at injection time; informational
+#       provenance for soak/bench rows).
+#   BYZ_FAULTS_PREFIX — what the system OBSERVED: for protocol-
+#       detectable kinds the verifier folds matching fault_log entries
+#       in; for kinds undetectable by design in an asynchronous system
+#       (withheld shares, link loss/delay) the injection layer stamps
+#       the counter directly — the DECLARED observable of
+#       sim/scenario.py:FAULT_OBSERVABLES.
+BYZ_INJECTED_PREFIX = "byz_injected_"
+BYZ_FAULTS_PREFIX = "byz_faults_"
+
 
 class Counter:
     __slots__ = ("value",)

@@ -63,6 +63,17 @@ def main(argv=None) -> int:
         "--byzantine", default=None, metavar="I,J,...",
         help="these node indices replay old messages alongside real traffic",
     )
+    p.add_argument(
+        "--attack", type=int, default=None, metavar="F",
+        help="Byzantine scenario plane: the LAST F nodes run the full "
+        "attack catalog (equivocating RBC, withheld + garbage "
+        "decryption shares, replay floods — sim/byzantine.py); the "
+        "fault-observability contract is verified at exit (every "
+        "injected fault kind must have surfaced).  Combine with "
+        "--encrypt --verify so forged shares travel the real verify "
+        "plane.  F defaults to the tolerance bound (n-1)//3 with "
+        "--attack -1",
+    )
     p.add_argument("--json", action="store_true", help="emit metrics as JSON")
     p.add_argument(
         "--trace", default=None, metavar="PATH",
@@ -115,6 +126,7 @@ def main(argv=None) -> int:
             ("--delay", args.delay > 0),
             ("--crash", args.crash is not None),
             ("--byzantine", args.byzantine is not None),
+            ("--attack", args.attack is not None),
         ]
         if active
     ]
@@ -130,6 +142,17 @@ def main(argv=None) -> int:
     # not the CLI --nodes value
     n_nodes = args.nodes
     resumed = None
+    if args.attack is not None and (args.resume or args.checkpoint):
+        # a ScenarioSpec compiles into node wrappers at construction
+        # time; a checkpointed topology cannot be re-wrapped coherently
+        # (checkpoint.sim_to_bytes enforces the same on the save side)
+        p.error("--attack is not supported with --resume/--checkpoint")
+    if args.attack is not None and args.encrypt and not args.verify:
+        # without share verification the garbage G1 points are absorbed
+        # silently and the observability contract rightly fails at exit
+        # — reject the known-invalid config up front with the real cause
+        p.error("--attack with --encrypt requires --verify (forged "
+                "decryption shares must travel the verify plane)")
     if args.resume:
         if fault_flags:
             # a fresh adversary replaces whatever the checkpoint ran with
@@ -141,7 +164,16 @@ def main(argv=None) -> int:
         n_nodes = resumed.cfg.n_nodes
 
     adversary = None
+    scenario = None
     try:
+        if args.attack is not None:
+            from .scenario import attack_spec
+
+            scenario = attack_spec(
+                args.nodes,
+                None if args.attack < 0 else args.attack,
+                seed=args.seed,
+            )
         if args.drop > 0:
             adversary = drop_adversary(args.drop, args.seed)
         elif args.dup > 0:
@@ -174,6 +206,7 @@ def main(argv=None) -> int:
             engine=args.engine,
             seed=args.seed,
             adversary=adversary,
+            scenario=scenario,
             trace=bool(args.trace),
         )
         net = SimNetwork(cfg)
@@ -191,6 +224,16 @@ def main(argv=None) -> int:
         if args.checkpoint:
             ckpt_mod.save_sim(args.checkpoint, net)
 
+    if scenario is not None:
+        # the fault-observability contract: every injected fault kind
+        # surfaced as a fault_log entry / byz_faults_* counter, or die
+        net.verify_scenario()
+        net.shutdown()
+        print(
+            "attack scenario verified: injected "
+            + json.dumps(net.scenario_log.counts, sort_keys=True),
+            file=sys.stderr,
+        )
     if args.trace:
         from ..obs import export as obs_export
 

@@ -49,6 +49,15 @@ class SimConfig:
     seed: int = 0
     shuffle: bool = True
     adversary: Optional[Callable] = None
+    # adversarial scenario plane (sim/scenario.py): a declarative
+    # ScenarioSpec compiles into a router adversary (link faults,
+    # partition+heal) plus ByzantineNode wrappers (sim/byzantine.py)
+    # for the nodes it names.  Mutually exclusive with `adversary`;
+    # disables the native ACS fast path (Byzantine traffic must travel
+    # the real message plane).  Attack strategies that forge decryption
+    # shares assume verify_shares=True — unverified garbage shares
+    # would poison the combine and break agreement by design.
+    scenario: Optional[object] = None
     # router quiescence budget per epoch; None = auto (the message
     # complexity of an epoch is O(N^3): N broadcast instances x O(N^2))
     max_messages_per_epoch: Optional[int] = None
@@ -212,10 +221,47 @@ class SimNetwork:
             }
         else:
             raise ValueError(f"unknown protocol {cfg.protocol!r}")
+        # adversarial scenario plane: compile the spec into the router
+        # adversary and wrap the named nodes in attack strategies
+        adversary = cfg.adversary
+        self.scenario_log = None
+        scen = getattr(cfg, "scenario", None)
+        if scen is not None:
+            if adversary is not None:
+                raise ValueError(
+                    "SimConfig.scenario and SimConfig.adversary are "
+                    "mutually exclusive"
+                )
+            from . import byzantine as byz
+            from .scenario import ScenarioAdversary
+
+            adv = ScenarioAdversary(scen, self.ids, metrics=self.metrics)
+            adversary = adv
+            self.scenario_log = adv.log
+            for idx, names in sorted(scen.byzantine_map().items()):
+                nid = self.ids[idx]
+                # wrapping replaces an entry; the roster never grows
+                # beyond the fixed topology (lint: attacker-taint)
+                if len(self.nodes) != len(self.ids):
+                    raise RuntimeError("node roster drifted")
+                self.nodes[nid] = byz.ByzantineNode(
+                    self.nodes[nid],
+                    byz.build_strategies(
+                        names,
+                        random.Random(scen.seed * 7919 + 11 + idx),
+                        adv.log,
+                    ),
+                    log=adv.log,
+                )
+        self.honest_ids = [
+            nid
+            for nid in self.ids
+            if not hasattr(self.nodes[nid], "unwrap")
+        ]
         self.router = Router(
             self.ids,
             self._handle,
-            adversary=cfg.adversary,
+            adversary=adversary,
             seed=cfg.seed + 3,
             shuffle=cfg.shuffle,
             recorder=self.recorder,
@@ -237,6 +283,8 @@ class SimNetwork:
         self.__dict__.setdefault("epoch_durations", [])
         self.__dict__.setdefault("recorder", NULL_RECORDER)
         self.__dict__.setdefault("metrics", MetricsRegistry())
+        self.__dict__.setdefault("honest_ids", list(self.ids))
+        self.__dict__.setdefault("scenario_log", None)
         if getattr(self.router, "drain_hook", None) is None:
             self.router.drain_hook = self._drain_async
 
@@ -255,6 +303,7 @@ class SimNetwork:
             return False
         ok = (
             cfg.adversary is None
+            and getattr(cfg, "scenario", None) is None
             and not cfg.encrypt
             and cfg.coin_mode == "hash"
             and cfg.protocol in ("qhb", "dhb")
@@ -365,6 +414,11 @@ class SimNetwork:
         from ..crypto import futures as _futures
 
         _futures.stamp_gauges(self.metrics)
+        # a CryptoFuture dropped unmaterialized (e.g. a Byzantine-
+        # induced early exit unwinding past a submit) means device work
+        # and its protocol effect were silently discarded: fail the run
+        # HERE, at the tick boundary, not just in a teardown log line
+        _futures.check_dropped()
 
     def _run_epoch_inner(self) -> None:
         t0 = time.perf_counter()
@@ -410,7 +464,11 @@ class SimNetwork:
         m.wall_s = self.total_wall_s
         m.messages_delivered = self.router.delivered
         m.faults = len(self.router.faults)
-        m.epochs_done = min(len(self._batches(nid)) for nid in self.ids)
+        # progress/agreement are judged over the HONEST nodes: a
+        # Byzantine wrapper's core is honest underneath, but liveness-
+        # under-attack is a claim about what the honest quorum commits
+        honest = getattr(self, "honest_ids", None) or self.ids
+        m.epochs_done = min(len(self._batches(nid)) for nid in honest)
         m.agreement_ok = self._check_agreement()
         if self.epoch_durations:
             ordered = sorted(self.epoch_durations)
@@ -422,7 +480,7 @@ class SimNetwork:
             m.latency_p50_ms = pct(0.50)
             m.latency_p90_ms = pct(0.90)
             m.latency_p99_ms = pct(0.99)
-        for batch in self._batches(self.ids[0]):
+        for batch in self._batches(honest[0]):
             for _, txns in sorted(batch.contributions.items()):
                 if isinstance(txns, (list, tuple)):
                     m.txns_committed += len(txns)
@@ -430,6 +488,36 @@ class SimNetwork:
                 else:
                     m.bytes_committed += len(txns)
         return m
+
+    def verify_scenario(self) -> None:
+        """Assert the fault-observability contract: every fault kind the
+        scenario injected surfaced as a fault_log entry, a
+        ``byz_faults_*`` counter, or a declared queue high-water
+        (sim/scenario.py:FAULT_OBSERVABLES).  Also folds the run's
+        fault_log into the ``byz_faults_*`` counter family so soak and
+        bench rows carry per-kind detection counts."""
+        if self.scenario_log is None:
+            raise RuntimeError("no scenario attached to this SimNetwork")
+        from .scenario import assert_observability, fold_fault_counters
+
+        fold_fault_counters(
+            self.router.faults,
+            self.metrics,
+            injected=set(self.scenario_log.counts),
+        )
+        assert_observability(
+            self.scenario_log, self.router.faults, self.metrics
+        )
+
+    def shutdown(self) -> None:
+        """Teardown: settle every node's in-flight device work, then
+        fail LOUDLY if any CryptoFuture was ever dropped unmaterialized
+        — an early exit (Byzantine-induced or otherwise) must not
+        silently discard device work and its protocol effect."""
+        self._drain_async()
+        from ..crypto import futures as _futures
+
+        _futures.check_dropped()
 
     def queue_peaks(self) -> dict:
         """High-water marks of the sim tier's bounded buffers — the
@@ -466,9 +554,10 @@ class SimNetwork:
                     items.append((p, bytes(v)))
             return tuple(items)
 
-        seqs = {nid: [key(b) for b in self._batches(nid)] for nid in self.ids}
+        honest = getattr(self, "honest_ids", None) or self.ids
+        seqs = {nid: [key(b) for b in self._batches(nid)] for nid in honest}
         shortest = min(len(s) for s in seqs.values())
-        first = seqs[self.ids[0]][:shortest]
+        first = seqs[honest[0]][:shortest]
         return all(s[:shortest] == first for s in seqs.values())
 
 

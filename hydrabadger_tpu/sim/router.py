@@ -107,6 +107,28 @@ class Router:
         if self.adversary is not None:
             replacement = self.adversary(sender, recipient, message)
             if replacement is not None:
+                # The router is the single enqueue chokepoint, so it
+                # accounts the adversary's MECHANICAL wire effects.
+                # Purely positional — one inject() call may drop the
+                # original WHILE releasing frames held earlier, so
+                # intent (drop vs hold vs duplicate) is only knowable
+                # to the adversary itself (InjectionLog counts it by
+                # taxonomy kind; these counters are the cross-check):
+                #   absorbed — the original frame did not pass through
+                #       this call (dropped, or held for later release);
+                #   emitted  — extra frames beyond the pass-through
+                #       (duplicates, replays, releases of held frames).
+                if self.metrics is not None:
+                    passed = sum(
+                        1 for _s, _r, m in replacement if m is message
+                    )
+                    if passed == 0:
+                        self.metrics.counter("router_adv_absorbed").inc()
+                    extra = len(replacement) - min(passed, 1)
+                    if extra > 0:
+                        self.metrics.counter("router_adv_emitted").inc(
+                            extra
+                        )
                 self.queue.extend(replacement)
                 return
         self.queue.append((sender, recipient, message))

@@ -116,6 +116,104 @@ def sim_soak(epochs: int = 1000, n_nodes: int = 16,
     }
 
 
+def byz_soak(epochs: int = 200, n_nodes: int = 4,
+             rss_budget_mb: float = 256.0) -> Dict:
+    """Liveness-under-attack tier (ROADMAP item 5): the full-crypto sim
+    with the LAST ``f`` nodes running the complete attack catalog
+    (equivocating RBC, withheld + garbage decryption shares, replay
+    floods).  Asserts the honest quorum commits every epoch in
+    agreement at a rate within 2x of an honest-only calibration leg at
+    the same config, and that every injected fault kind surfaced
+    through the observability contract — committed-epochs/s and
+    per-kind fault counts are first-class row fields."""
+    from .network import SimConfig, SimNetwork
+    from .scenario import attack_spec
+
+    def cfg(scenario):
+        return SimConfig(
+            n_nodes=n_nodes, protocol="qhb", encrypt=True,
+            verify_shares=True, txns_per_node_per_epoch=5, txn_bytes=8,
+            seed=17, scenario=scenario,
+        )
+
+    # honest calibration leg: same config, no scenario — the 2x bound's
+    # denominator (short: the ratio stabilizes within tens of epochs).
+    # Both legs exclude a warmup window from their timed rate, or the
+    # honest leg (which runs first in a fresh process) would pay the
+    # one-time jit/codec cold-start alone, bias honest_eps low and
+    # silently weaken the 2x gate
+    honest = SimNetwork(cfg(None))
+    calib = max(10, min(epochs // 2, 40))
+    honest.run(5)
+    warm_wall = honest.total_wall_s
+    honest.run(calib)
+    honest_eps = calib / (honest.total_wall_s - warm_wall)
+    honest.shutdown()  # the dropped-future ledger is process-global:
+    # settle the honest leg's futures HERE or a leak would be
+    # misattributed to the attacked run below
+
+    net = SimNetwork(cfg(attack_spec(n_nodes, seed=17)))
+    net.run(5)  # warmup — excluded from rate like the honest leg's
+    rss0 = rss_mb()
+    t0 = time.perf_counter()
+    chunk = max(1, epochs // 10)
+    done = 0
+    trimmed = 0
+    while done < epochs:
+        m = net.run(chunk)
+        done += chunk
+        assert m.agreement_ok, "byz soak: honest quorum lost agreement"
+        # trim the deliberately-unbounded batch history (see sim_soak);
+        # every node's core is honest underneath, so all of them grow
+        window = min(len(net._batches(nid)) for nid in net.ids)
+        if window > 4:
+            cut = window - 4
+            trimmed += cut
+            for nid in net.ids:
+                del net._batches(nid)[:cut]
+    wall = time.perf_counter() - t0
+    rss1 = rss_mb()
+    committed = trimmed + min(
+        len(net._batches(nid)) for nid in net.honest_ids
+    )
+    attacked_eps = done / wall
+    assert committed >= epochs + 5, "byz soak under-ran"
+    assert rss1 - rss0 < rss_budget_mb, (
+        f"byz soak RSS grew {rss1 - rss0:.1f} MB (> {rss_budget_mb})"
+    )
+    # the acceptance bound: attack costs at most 2x throughput
+    assert attacked_eps >= 0.5 * honest_eps, (
+        f"byz soak: attacked rate {attacked_eps:.2f} eps fell below "
+        f"half the honest baseline {honest_eps:.2f} eps"
+    )
+    # every injected fault kind surfaced as a declared observable —
+    # silent tolerance fails the tier (also folds fault_log counts
+    # into the byz_faults_* counters the row carries)
+    net.verify_scenario()
+    net.shutdown()
+    counters = net.metrics.snapshot()["counters"]
+    f = n_nodes - len(net.honest_ids)
+    return {
+        "tier": f"sim_byzantine_{n_nodes}node_full_crypto",
+        "n_byzantine": f,
+        "epochs": committed,
+        "epochs_per_sec": round(attacked_eps, 2),
+        "honest_epochs_per_sec": round(honest_eps, 2),
+        "vs_honest_baseline": round(attacked_eps / honest_eps, 3),
+        "rss_start_mb": round(rss0, 1),
+        "rss_end_mb": round(rss1, 1),
+        "rss_growth_mb": round(rss1 - rss0, 1),
+        "queue_peaks": net.queue_peaks(),
+        "byz_injected": dict(net.scenario_log.counts),
+        "byz_faults": {
+            k: v for k, v in sorted(counters.items())
+            if k.startswith("byz_faults_")
+        },
+        "agreement_ok": True,
+        "metrics": net.metrics.snapshot(),
+    }
+
+
 def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
     """4-node localhost cluster, DEFAULT (full) crypto tier, to
     `epochs` committed batches with queue/RSS bounds sampled live."""
@@ -245,15 +343,27 @@ def main(argv=None) -> int:
     p.add_argument("--epochs", type=int, default=1000)
     p.add_argument("--tcp-epochs", type=int, default=None,
                    help="TCP tier target (default: same as --epochs)")
+    p.add_argument("--byz-epochs", type=int, default=None,
+                   help="Byzantine tier target (default: --epochs / 5 — "
+                   "the full-crypto attacked tier is the slowest)")
     p.add_argument("--skip-tcp", action="store_true")
+    p.add_argument("--skip-byz", action="store_true")
+    p.add_argument("--byz-only", action="store_true",
+                   help="run ONLY the Byzantine liveness-under-attack "
+                   "tier (the scripts/test-all SOAK gate)")
     p.add_argument("--out", default="SOAK.json")
     args = p.parse_args(argv)
 
     results = []
-    r = sim_soak(args.epochs)
-    print(json.dumps(r), flush=True)
-    results.append(r)
-    if not args.skip_tcp:
+    if not args.byz_only:
+        r = sim_soak(args.epochs)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if not args.skip_byz:
+        r = byz_soak(args.byz_epochs or max(20, args.epochs // 5))
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if not args.skip_tcp and not args.byz_only:
         r = tcp_soak(args.tcp_epochs or args.epochs)
         print(json.dumps(r), flush=True)
         results.append(r)

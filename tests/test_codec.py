@@ -230,3 +230,52 @@ def test_forged_collection_counts_rejected():
     # legitimate collections (count == remaining capacity) still decode
     assert codec._py_decode(codec._py_encode((None, True))) == (None, True)
     assert codec._py_decode(codec._py_encode({1: 2})) == {1: 2}
+
+
+# -- malformed-variant fuzz (shared with hblint) -----------------------------
+#
+# The adversarial twin of the round-trip pin above: wire decode must
+# reject every malformed frame with ValueError — the read loops' fault
+# path — and NEVER let another exception type escape (a remote peer
+# could otherwise crash the reader task with crafted bytes).
+
+
+def test_every_malformed_wire_variant_rejected_with_valueerror():
+    from hydrabadger_tpu.lint import wire_contract
+    from hydrabadger_tpu.net.wire import WireMessage
+
+    corpus = wire_contract.malformed_samples()
+    assert len(corpus) > 60  # truncations track KINDS automatically
+    for label, raw in corpus:
+        try:
+            WireMessage.decode(raw)
+        except ValueError:
+            continue  # the one sanctioned exit
+        except BaseException as e:  # pragma: no cover - the failure
+            pytest.fail(f"{label}: {type(e).__name__} escaped: {e}")
+        else:
+            pytest.fail(f"{label}: malformed frame decoded successfully")
+
+
+def test_bitflipped_wire_frames_never_escape_valueerror():
+    """Seeded mutation fuzz over every honest variant: a flipped or
+    truncated frame may still decode (benign flips exist), but the only
+    exception that may escape is ValueError."""
+    import random
+
+    from hydrabadger_tpu.lint import wire_contract
+    from hydrabadger_tpu.net.wire import WireMessage
+
+    rng = random.Random(0xB12)
+    for msg in wire_contract.sample_messages():
+        raw = bytearray(msg.encode())
+        for _ in range(80):
+            buf = bytearray(raw)
+            for _ in range(rng.randint(1, 3)):
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            if rng.random() < 0.3:
+                buf = buf[: rng.randrange(len(buf))]
+            try:
+                WireMessage.decode(bytes(buf))
+            except ValueError:
+                pass

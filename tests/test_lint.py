@@ -638,3 +638,43 @@ def test_guard_direction_clamps_the_bounded_side(tmp_path):
     # was not
     flagged_lines = [m for m in messages if "tainted loop bound" in m]
     assert len(flagged_lines) == 1, messages
+
+
+@pytest.mark.lint
+def test_scenario_plane_taint_sources_fire_on_known_bad(tmp_path):
+    """The Byzantine scenario plane's hooks are attacker-taint sources
+    (registry: sim/scenario.py inject, sim/byzantine.py handle_message /
+    on_receive): adversary-relayed frames flowing into a loop bound or
+    an unbounded container must be flagged exactly like router frames."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "sim/scenario.py": """\
+                class Adversary:
+                    def __init__(self):
+                        self.seen = []
+
+                    def inject(self, sender, recipient, message):
+                        for part in message:
+                            self.seen.append(part)
+                        return None
+                """,
+            "sim/byzantine.py": """\
+                class ByzantineNode:
+                    def __init__(self):
+                        self.history = []
+
+                    def handle_message(self, sender, message):
+                        n = len(message)
+                        for _ in range(n):
+                            pass
+
+                    def on_receive(self, node, sender, message):
+                        self.history.append(message)
+                """,
+        },
+    )
+    messages = [f.render() for f in taint.check(sf)]
+    assert any("unbounded growth of self.seen" in m for m in messages)
+    assert any("tainted loop bound" in m for m in messages)
+    assert any("unbounded growth of self.history" in m for m in messages)

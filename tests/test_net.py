@@ -121,10 +121,10 @@ async def test_late_joiner_becomes_observer_then_validator():
         )
         await joiner.start([OutAddr("127.0.0.1", BASE_PORT + 20)], gen_txns)
         nodes.append(joiner)
-        ok = await wait_for(lambda: joiner.dhb is not None, timeout=20)
+        ok = await wait_for(lambda: joiner.dhb is not None, timeout=45)
         assert ok, "joiner never became an observer"
         assert joiner.state in ("observer", "validator")
-        ok = await wait_for(lambda: joiner.is_validator(), timeout=60)
+        ok = await wait_for(lambda: joiner.is_validator(), timeout=90)
         assert ok, f"joiner stuck as {joiner.state} (era {joiner.dhb.era})"
         # the promoted validator proposes and its contribution commits
         marker = codec.encode((b"from-the-joiner",))
@@ -232,8 +232,12 @@ async def test_restart_world_from_checkpoints_over_tcp():
     base = BASE_PORT + 50
     nodes = await start_cluster(3, base)
     try:
+        # generous timeouts throughout this test: it runs late in the
+        # suite on a host still paging XLA compile heap, and a slow
+        # commit is indistinguishable from a loaded scheduler — a
+        # genuinely broken restore never commits at ANY timeout
         assert await wait_for(
-            lambda: min(len(n.batches) for n in nodes) >= 2, timeout=30
+            lambda: min(len(n.batches) for n in nodes) >= 2, timeout=60
         )
     except BaseException:
         await stop_cluster(nodes)
@@ -258,7 +262,7 @@ async def test_restart_world_from_checkpoints_over_tcp():
             ]
             await node.start(remotes, gen_txns)
         assert await wait_for(
-            lambda: min(len(n.batches) for n in restored) >= 2, timeout=30
+            lambda: min(len(n.batches) for n in restored) >= 2, timeout=90
         ), "restored network never committed"
         firsts = {
             tuple(sorted(n.batches[0].contributions.items()))
@@ -591,3 +595,45 @@ async def test_internal_put_overflow_defers_not_drops():
     assert node._internal.qsize() == 2
     await asyncio.sleep(0)  # done-callback pruned the tracking set
     assert not node._overflow_tasks
+
+
+def test_replay_backoff_rate_limits_a_sustained_flood():
+    """Regression for the PR-2 `_last_replay_t` gate under sustained
+    flood: a genuinely wedged epoch polling the gate every tick must be
+    rate-limited to the declared cadence — inter-replay spacing doubles
+    up to 16x the stall threshold regardless of stall age — and every
+    suppressed tick must be counted, not silent."""
+    from hydrabadger_tpu.net.node import EPOCH_REPLAY_TICK_S
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 97), fast_config(), seed=9
+    )
+    node._last_progress_t = 0.0
+    node._last_replay_t = 0.0
+    # ema is None at this point, so threshold = max(3*tick, 2*tick)
+    threshold = 3.0 * EPOCH_REPLAY_TICK_S
+    fired = []
+    horizon = 600
+    for tick in range(1, horizon + 1):
+        if node._replay_due(float(tick)):
+            fired.append(tick)
+    # the flood is bounded by the declared schedule: doubling gaps
+    # (3,9,21,45,93) then one replay per 16x-threshold interval — NOT
+    # one per tick and NOT the 1/s revert the pre-`_last_replay_t`
+    # gate degraded to
+    assert fired[:5] == [3, 9, 21, 45, 93]
+    steady = [b - a for a, b in zip(fired[4:], fired[5:])]
+    assert steady and all(gap == 16 * threshold for gap in steady)
+    assert len(fired) <= 5 + horizon / (16 * threshold) + 1
+    assert node.metrics.counter("epoch_replays").value == len(fired)
+    # every suppressed wedged tick is observable (ticks before the
+    # stall threshold are "not stalled yet", neither fired nor
+    # suppressed)
+    stalled_ticks = horizon - int(threshold) + 1
+    suppressed = node.metrics.counter("epoch_replays_suppressed").value
+    assert suppressed == stalled_ticks - len(fired)
+    # progress resets the backoff: the next stall starts at 1x again
+    node._replay_backoff = 1.0
+    node._last_progress_t = float(horizon)
+    assert not node._replay_due(float(horizon) + threshold / 2)
+    assert node._replay_due(float(horizon) + threshold)

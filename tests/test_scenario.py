@@ -1,0 +1,389 @@
+"""Adversarial scenario plane tests (ROADMAP item 5).
+
+Three layers, mirroring sim/scenario.py + sim/byzantine.py:
+
+  * injection mechanics — link policies, partition windows and the
+    flush/heal contract on the compiled ScenarioAdversary;
+  * the fault-observability contract — every injected kind must surface
+    as a fault_log entry / ``byz_faults_*`` counter / declared gauge
+    high-water, and (crucially) an UNOBSERVED injection must FAIL the
+    verifier: silent tolerance is a test failure, not a shrug;
+  * liveness-under-attack — the canonical attack scenario (equivocating
+    RBC, withheld + garbage decryption shares, replay floods, DKG
+    corruption under churn) at 4 nodes in tier-1 and 16 nodes in the
+    slow tier, with the PR-5 async/sync point-identity pin extended to
+    an attacked era.
+"""
+import random
+
+import pytest
+
+from hydrabadger_tpu.consensus import types as T
+from hydrabadger_tpu.obs.metrics import BYZ_FAULTS_PREFIX, MetricsRegistry
+from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+from hydrabadger_tpu.sim.scenario import (
+    FAULT_OBSERVABLES,
+    InjectionLog,
+    LinkPolicy,
+    PartitionWindow,
+    ScenarioAdversary,
+    ScenarioSpec,
+    assert_observability,
+    attack_spec,
+    verify_observability,
+)
+
+pytestmark = pytest.mark.byz
+
+
+# -- injection mechanics -----------------------------------------------------
+
+
+def _adv(spec, n=4):
+    ids = [f"n{i:03d}" for i in range(n)]
+    return ScenarioAdversary(spec, ids, metrics=MetricsRegistry()), ids
+
+
+def test_link_drop_policy_counts_every_loss():
+    adv, ids = _adv(ScenarioSpec(seed=1, default_link=LinkPolicy(drop=1.0)))
+    for k in range(10):
+        assert adv.inject(ids[0], ids[1], ("m", k)) == []
+    assert adv.log.counts[T.BYZ_LINK_DROP] == 10
+    assert adv.flush() == []  # drops are LOSS, not holds
+
+
+def test_link_duplicate_policy_amplifies_and_counts():
+    adv, ids = _adv(
+        ScenarioSpec(seed=1, default_link=LinkPolicy(duplicate=1.0))
+    )
+    out = adv.inject(ids[0], ids[1], ("m", 0))
+    assert out == [(ids[0], ids[1], ("m", 0))] * 2
+    assert adv.log.counts[T.BYZ_LINK_DUP] == 1
+
+
+def test_link_delay_holds_then_releases_without_loss():
+    adv, ids = _adv(
+        ScenarioSpec(
+            seed=1, default_link=LinkPolicy(delay=1.0, delay_max=4)
+        )
+    )
+    held = [("m", k) for k in range(6)]
+    released = []
+    for msg in held:
+        out = adv.inject(ids[0], ids[1], msg) or []
+        released.extend(out)  # expired holds ride later enqueues
+        assert msg not in [m for _s, _r, m in out]  # never same-tick
+    released.extend(adv.flush())  # quiescence releases the rest
+    assert sorted(m for _s, _r, m in released) == sorted(held)
+    assert adv.log.counts[T.BYZ_LINK_DELAY] == 6
+
+
+def test_first_matching_link_policy_wins():
+    spec = ScenarioSpec(
+        seed=1,
+        links=(
+            (0, 1, LinkPolicy(drop=1.0)),
+            (None, None, LinkPolicy()),  # clean default for the rest
+        ),
+    )
+    adv, ids = _adv(spec)
+    assert adv.inject(ids[0], ids[1], "x") == []  # severed link
+    assert adv.inject(ids[1], ids[0], "y") is None  # reverse dir clean
+
+
+def test_partition_window_severs_then_heals():
+    spec = ScenarioSpec(
+        seed=1,
+        partitions=(
+            PartitionWindow(groups=((0, 1), (2, 3)), start=0, heal=4),
+        ),
+    )
+    adv, ids = _adv(spec)
+    # cross-group: held; intra-group: delivered
+    assert adv.inject(ids[0], ids[2], "cross") == []
+    assert adv.inject(ids[0], ids[1], "intra") is None
+    assert adv.log.counts[T.BYZ_PARTITION] == 1
+    # enqueues 3, 4 cross the heal boundary: the held frame re-emerges
+    adv.inject(ids[1], ids[0], "a")
+    out = adv.inject(ids[2], ids[3], "b") or []
+    released = [(s, r, m) for s, r, m in out if m == "cross"]
+    assert released == [(ids[0], ids[2], "cross")]
+
+
+def test_open_partition_heals_at_flush():
+    spec = ScenarioSpec(
+        seed=1,
+        partitions=(PartitionWindow(groups=((0,), (1,)), start=0),),
+    )
+    adv, ids = _adv(spec)
+    assert adv.inject(ids[0], ids[1], "held") == []
+    assert (ids[0], ids[1], "held") in adv.flush()
+
+
+def test_scenario_and_adversary_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        SimNetwork(
+            SimConfig(
+                n_nodes=4,
+                seed=1,
+                adversary=lambda s, r, m: None,
+                scenario=ScenarioSpec(),
+            )
+        )
+
+
+def test_unknown_strategy_name_rejected():
+    from hydrabadger_tpu.sim.byzantine import build_strategies
+
+    with pytest.raises(ValueError, match="unknown Byzantine strategy"):
+        build_strategies(["no_such_attack"], random.Random(0), InjectionLog())
+
+
+def test_attack_spec_bounds_f():
+    with pytest.raises(ValueError):
+        attack_spec(4, n_byzantine=2)  # f max is (4-1)//3 = 1
+    assert len(attack_spec(16).byzantine_map()) == 5
+
+
+# -- the observability contract ----------------------------------------------
+
+
+def test_unobserved_injection_fails_the_verifier():
+    """The acceptance-criterion pin: an injected fault kind with NO
+    materialized observable must FAIL the check — a system that
+    tolerates an attack silently is indistinguishable from one that
+    never saw it."""
+    log = InjectionLog(metrics=None)  # no metrics: nothing self-counts
+    log.note(T.BYZ_EQUIVOCATION, 3)
+    violations = verify_observability(log, faults=[], metrics=MetricsRegistry())
+    assert len(violations) == 1
+    assert "tolerated it silently" in violations[0]
+    with pytest.raises(AssertionError, match="observability contract"):
+        assert_observability(log, [], MetricsRegistry())
+
+
+def test_unregistered_fault_kind_is_itself_a_violation():
+    """A new attack cannot ship without an observability story."""
+    log = InjectionLog()
+    log.note("novel_attack", 1)
+    violations = verify_observability(log, [], MetricsRegistry())
+    assert any("no FAULT_OBSERVABLES entry" in v for v in violations)
+
+
+def test_matching_fault_log_entry_satisfies_the_contract():
+    log = InjectionLog()
+    log.note(T.BYZ_EQUIVOCATION, 1)
+    fault = T.Fault("n001", "broadcast: mixed echo roots (proposer ...)")
+    assert verify_observability(log, [("n0", fault)], MetricsRegistry()) == []
+
+
+def test_self_counting_kinds_observed_via_their_counter():
+    """Withheld shares are undetectable by design in an asynchronous
+    system; the declared observable is the injection counter itself."""
+    metrics = MetricsRegistry()
+    log = InjectionLog(metrics=metrics)
+    log.note(T.BYZ_WITHHELD_SHARE, 2)
+    assert metrics.counter(
+        BYZ_FAULTS_PREFIX + T.BYZ_WITHHELD_SHARE
+    ).value == 2
+    assert verify_observability(log, [], metrics) == []
+
+
+def test_every_taxonomy_kind_has_an_observables_entry():
+    assert set(FAULT_OBSERVABLES) == set(T.BYZ_KINDS)
+
+
+# -- liveness under attack ---------------------------------------------------
+
+
+def _run_attack(n_nodes, epochs, seed, protocol="qhb", spec=None, **kw):
+    cfg = SimConfig(
+        n_nodes=n_nodes,
+        protocol=protocol,
+        epochs=epochs,
+        seed=seed,
+        encrypt=True,
+        verify_shares=True,
+        scenario=spec or attack_spec(n_nodes, seed=seed),
+        **kw,
+    )
+    net = SimNetwork(cfg)
+    m = net.run()
+    return net, m
+
+
+def test_attack_scenario_4node_liveness_and_observability():
+    """The canonical liveness-under-attack pin: f=1 Byzantine running
+    the full catalog; honest nodes commit every epoch in agreement, and
+    every injected kind surfaces through the contract."""
+    net, m = _run_attack(4, 3, seed=2)
+    assert m.agreement_ok
+    assert m.epochs_done == 3
+    log = net.scenario_log
+    for kind in (
+        T.BYZ_EQUIVOCATION,
+        T.BYZ_GARBAGE_SHARE,
+        T.BYZ_WITHHELD_SHARE,
+        T.BYZ_REPLAY_FLOOD,
+    ):
+        assert log.counts.get(kind, 0) > 0, f"{kind} never injected"
+    net.verify_scenario()
+    net.shutdown()
+    # the garbage G1 points travelled the batch verify plane and were
+    # attributed to the attacker, not merely dropped
+    fault_kinds = {f.kind for _nid, f in net.router.faults}
+    assert any("threshold_decrypt: invalid share" in k for k in fault_kinds)
+    assert any("broadcast: mixed echo roots" in k for k in fault_kinds)
+
+
+def test_dkg_corrupt_under_churn_faults_and_commits():
+    """A Byzantine validator stuffs malformed Part/Ack/unknown keygen
+    messages into its committed contributions while the network votes
+    it out; the era switch completes and the corruption is attributed."""
+    spec = ScenarioSpec(name="dkg", seed=7, byzantine=((3, ("dkg_corrupt",)),))
+    cfg = SimConfig(n_nodes=4, protocol="dhb", epochs=4, seed=7, scenario=spec)
+    net = SimNetwork(cfg)
+    for nid in net.honest_ids:
+        net.router.dispatch_step(nid, net.nodes[nid].vote_to_remove(net.ids[3]))
+    m = net.run()
+    assert m.agreement_ok
+    assert m.epochs_done == 4
+    assert net.scenario_log.counts.get(T.BYZ_DKG_CORRUPT, 0) > 0
+    net.verify_scenario()
+    fault_kinds = {f.kind for _nid, f in net.router.faults}
+    assert any("keygen" in k for k in fault_kinds)
+    # the change committed: honest nodes switched era
+    assert all(net.nodes[nid].era > 0 for nid in net.honest_ids)
+
+
+def test_attack_with_link_faults_and_partition_heals():
+    """Attack strategies + lossy-ordering link schedule + a partition
+    window that heals: liveness must survive the combination (delay and
+    partition model reordering, never loss)."""
+    spec = ScenarioSpec(
+        name="combined",
+        seed=5,
+        default_link=LinkPolicy(duplicate=0.05, delay=0.1, delay_max=16),
+        partitions=(PartitionWindow(groups=((0, 1), (2, 3)), start=50, heal=400),),
+        byzantine=((3, ("equivocate", "withhold_shares", "garbage_shares")),),
+    )
+    net, m = _run_attack(4, 3, seed=5, spec=spec)
+    assert m.agreement_ok
+    assert m.epochs_done == 3
+    assert net.scenario_log.counts.get(T.BYZ_PARTITION, 0) > 0
+    net.verify_scenario()
+
+
+def test_async_sync_point_identity_under_attack():
+    """PR-5's tier-1 pattern extended to an adversarial scenario: the
+    honest nodes' committed batches must be identical with the hbasync
+    plane on and off, through a full attacked era switch (the Byzantine
+    validator is voted out while equivocating and corrupting keygen)."""
+    def run(async_on):
+        spec = ScenarioSpec(
+            name="era",
+            seed=9,
+            byzantine=((3, ("equivocate", "dkg_corrupt", "replay_flood")),),
+        )
+        cfg = SimConfig(
+            n_nodes=4,
+            protocol="dhb",
+            epochs=4,
+            seed=9,
+            scenario=spec,
+            async_dispatch=async_on,
+        )
+        net = SimNetwork(cfg)
+        for nid in net.honest_ids:
+            net.router.dispatch_step(
+                nid, net.nodes[nid].vote_to_remove(net.ids[3])
+            )
+        m = net.run()
+        assert m.agreement_ok
+        assert m.epochs_done == 4
+        net.verify_scenario()
+        net.shutdown()
+        batches = []
+        for b in net.nodes[net.honest_ids[0]].batches:
+            batches.append(
+                (
+                    b.era,
+                    b.epoch,
+                    tuple(
+                        (p, bytes(v))
+                        for p, v in sorted(b.contributions.items())
+                    ),
+                    b.change,
+                )
+            )
+        return batches
+
+    assert run(True) == run(False)
+
+
+def test_pre_ciphertext_share_equivocation_is_faulted():
+    """A Byzantine sender that equivocates BEFORE this node's ciphertext
+    arrives must be faulted at arrival time: the pending map keeps the
+    first share, so the overwrite can't launder the conflict past the
+    quorum-time conflicting-share check."""
+    from hydrabadger_tpu.consensus.threshold_decrypt import (
+        MSG_DEC_SHARE,
+        ThresholdDecrypt,
+    )
+    from hydrabadger_tpu.crypto import bls12_381 as bls
+    from hydrabadger_tpu.crypto.threshold import DecryptionShare
+
+    ni = T.NetworkInfo("n0", ["n0", "n1", "n2", "n3"], pk_set=None)
+    td = ThresholdDecrypt(ni)
+    first = DecryptionShare(bls.G1)
+    conflicting = DecryptionShare(bls.double(bls.G1))
+    assert td.handle_message("n1", (MSG_DEC_SHARE, first.to_bytes())).fault_log == []
+    # an identical replay stays silent (routine duplicate noise)
+    assert td.handle_message("n1", (MSG_DEC_SHARE, first.to_bytes())).fault_log == []
+    step = td.handle_message("n1", (MSG_DEC_SHARE, conflicting.to_bytes()))
+    assert any("conflicting share" in f.kind for f in step.fault_log)
+    # the FIRST share survives the equivocation attempt
+    assert td.pending["n1"].to_bytes() == first.to_bytes()
+
+
+def test_scenario_run_refuses_to_checkpoint():
+    """A scenario run holds its compiled ScenarioAdversary on the router
+    (cfg.adversary stays None), so the checkpoint adversary-stripping
+    protocol would record had_adversary=False and a resume would revive
+    the pickled ByzantineNode wrappers with the link adversary silently
+    gone.  Refuse on the save side."""
+    from hydrabadger_tpu.checkpoint import CheckpointError, sim_to_bytes
+
+    net = SimNetwork(
+        SimConfig(n_nodes=4, epochs=1, seed=3, scenario=attack_spec(4, seed=3))
+    )
+    with pytest.raises(CheckpointError, match="ScenarioSpec"):
+        sim_to_bytes(net)
+
+
+def test_dropped_future_fails_sim_teardown_loudly():
+    """Satellite: a CryptoFuture dropped unmaterialized (the signature
+    of a Byzantine-induced early exit unwinding past a submit) must
+    fail SimNetwork.shutdown(), not just write a log line."""
+    from hydrabadger_tpu.crypto import futures as fut
+
+    net = SimNetwork(SimConfig(n_nodes=4, epochs=1, seed=3))
+    net.run()
+    net.shutdown()  # clean run: no complaint
+    f = fut.CryptoFuture(lambda: 42, label="byz-orphan")
+    del f  # dropped without result()
+    with pytest.raises(RuntimeError, match="dropped without result"):
+        net.shutdown()
+    net.shutdown()  # the raise drained the ledger: loud exactly once
+
+
+@pytest.mark.slow
+def test_attack_scenario_16node_liveness():
+    """16 nodes, f=5 Byzantine running the full catalog: the SOAK-tier
+    geometry, committed in agreement with the contract verified."""
+    net, m = _run_attack(16, 2, seed=4)
+    assert m.agreement_ok
+    assert m.epochs_done == 2
+    assert len(net.honest_ids) == 11
+    net.verify_scenario()
+    net.shutdown()

@@ -1044,6 +1044,41 @@ def _byz_liveness_config11(epochs: int = 20) -> dict:
     }
 
 
+def _wire_chaos_config12(epochs: int = 10) -> dict:
+    """Round-8 wire-tier chaos row: the robustness twin of config 11 at
+    the layer that ships packets.  A 4-node localhost TCP cluster on
+    the FULL crypto tier (signed frames, threshold coin, encryption +
+    share verification) runs with f=1 Byzantine peer (withheld +
+    garbage G1 shares through the pairing verify plane, replay floods,
+    DKG corruption), in-flight signature corruption, link faults
+    (drop/duplicate/delay + resets + a 2 s partition window) and one
+    honest-validator crash restarted from a deliberately stale
+    checkpoint.  The run asserts honest-quorum liveness, byte-identical
+    recovery and the wire observability contract (net/chaos.py); the
+    headline metrics are the longest commit gap under fault and the
+    recovered node's catch-up time."""
+    from hydrabadger_tpu.net.chaos import run_chaos_cluster
+
+    row = run_chaos_cluster(epochs=epochs, base_port=3930)
+    return {
+        "metric": "wire_chaos_commit_gap_s_4node_f1_full_crypto",
+        "value": row["commit_gap_max_s"],
+        "unit": "s (longest inter-commit gap under fault)",
+        "recovery_catchup_s": row["recovery_catchup_s"],
+        "epochs_per_sec_under_fault": row["epochs_per_sec"],
+        "run": row,
+        "note": (
+            "4-node full-crypto TCP with f=1 Byzantine peer, link "
+            "faults (drop/dup/delay/reset + partition+heal), signature "
+            "corruption and one crash/restart from a stale checkpoint; "
+            "honest quorum committed every epoch in agreement, the "
+            "recovered node caught up byte-identically, and every "
+            "injected wire fault kind surfaced through the "
+            "observability contract"
+        ),
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1051,7 +1086,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
+        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
@@ -1064,7 +1099,9 @@ def main(argv=None) -> int:
         "10 = NTT-plane crossover sweep (RS encode + DKG poly-eval, "
         "n = 16..768, matrix/Horner vs FFT routes), 11 = Byzantine "
         "liveness-under-attack (4/16-node full-crypto sim, f attacking "
-        "nodes vs the honest twin)",
+        "nodes vs the honest twin), 12 = wire-tier chaos (4-node TCP, "
+        "f=1 Byzantine peer + link faults + crash/restart; commit gap "
+        "and recovery catch-up time)",
     )
     p.add_argument(
         "--epochs",
@@ -1152,6 +1189,10 @@ def main(argv=None) -> int:
             # scenario plane disables the native fast path by design)
             ("config11_byz_liveness",
              lambda: _byz_liveness_config11(epochs_or(20)), "always"),
+            # wire-tier chaos: real sockets, CPU crypto either way (the
+            # adversarial TCP cluster is a host-side robustness row)
+            ("config12_wire_chaos",
+             lambda: _wire_chaos_config12(epochs_or(10)), "always"),
         ]
         jax_ok = not probe.get("error")
         backend_lost = False
@@ -1280,6 +1321,8 @@ def main(argv=None) -> int:
         return single(_ntt_crossover_config10)
     if args.config == 11:
         return single(lambda: _byz_liveness_config11(epochs_or(20)))
+    if args.config == 12:
+        return single(lambda: _wire_chaos_config12(epochs_or(10)))
 
     # config 3 (also the fall-through for the bare invocation)
     return single(_rs_throughput_config3)

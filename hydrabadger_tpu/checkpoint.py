@@ -187,7 +187,14 @@ class NodeCheckpoint:
     @classmethod
     def capture(cls, secret_key: SecretKey,
                 dhb: DynamicHoneyBadger) -> "NodeCheckpoint":
-        """Snapshot a running DynamicHoneyBadger's durable state."""
+        """Snapshot a running DynamicHoneyBadger's durable state.
+
+        A Byzantine-wrapped core (sim/byzantine.ByzantineNode mounted by
+        the wire chaos harness) is unwrapped first: the checkpoint
+        captures the honest consensus identity — the attack strategies
+        are harness state, not durable state."""
+        if hasattr(dhb, "unwrap"):
+            dhb = dhb.unwrap()
         ni = dhb.netinfo
         share = ni.sk_share.to_bytes() if ni.sk_share is not None else b""
         return cls(

@@ -209,15 +209,22 @@ class DynamicHoneyBadger:
         rng=None,
         engine=None,
         recorder=None,
+        sk_share=None,
     ) -> "DynamicHoneyBadger":
         """Instantiate as an observer from a committed JoinPlan
-        (the reference's `new_joining` path, state.rs:200-250)."""
+        (the reference's `new_joining` path, state.rs:200-250).
+
+        ``sk_share`` re-installs a secret key share that is still valid
+        for the plan's era — the crash/restart fast-forward path
+        (net/node.py): a validator wedged behind the network within its
+        OWN era rebuilds at the certified epoch as a validator, not an
+        observer, because its era keys never changed."""
         pub_keys = {
             nid: PublicKey.from_bytes(bytes(pk))
             for nid, pk in plan.pub_keys.items()
         }
         pk_set = PublicKeySet.from_bytes(plan.pk_set_bytes)
-        netinfo = NetworkInfo(our_id, list(plan.node_ids), pk_set, None)
+        netinfo = NetworkInfo(our_id, list(plan.node_ids), pk_set, sk_share)
         dhb = cls(
             our_id,
             our_sk,

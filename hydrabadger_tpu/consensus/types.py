@@ -79,6 +79,11 @@ BYZ_LINK_DROP = "link_drop"  # per-link loss (breaks the reliable-delivery model
 BYZ_LINK_DUP = "link_dup"  # per-link duplication
 BYZ_LINK_DELAY = "link_delay"  # per-link hold/reorder
 BYZ_PARTITION = "partition"  # cross-group traffic held until heal
+# wire-tier-only kinds (net/chaos.py): injectable at the real socket
+# boundary, unreachable from the sim router's lossless message plane
+BYZ_LINK_RESET = "link_reset"  # connection torn down mid-stream (TCP RST)
+BYZ_SIG_CORRUPT = "sig_corrupt"  # frame signature bit-flipped in flight
+BYZ_CRASH = "crash_restart"  # validator SIGKILLed and restarted from checkpoint
 
 BYZ_KINDS = frozenset(
     {
@@ -91,6 +96,9 @@ BYZ_KINDS = frozenset(
         BYZ_LINK_DUP,
         BYZ_LINK_DELAY,
         BYZ_PARTITION,
+        BYZ_LINK_RESET,
+        BYZ_SIG_CORRUPT,
+        BYZ_CRASH,
     }
 )
 

@@ -40,6 +40,7 @@ class CryptoBridge:
         engine: EngineLike = None,
         max_batch: int = 512,
         max_delay_ms: float = 2.0,
+        metrics=None,
     ):
         self.engine = get_engine(engine)
         self.max_batch = max_batch
@@ -48,7 +49,11 @@ class CryptoBridge:
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
-        # counters (observability; SURVEY.md §5.5)
+        # counters (observability; SURVEY.md §5.5).  When a node's
+        # MetricsRegistry is passed, the same counts mirror into it as
+        # `bridge_batches_dispatched` / `bridge_requests_served`, so
+        # soak/bench/chaos rows fold them with everything else.
+        self.metrics = metrics
         self.batches_dispatched = 0
         self.requests_served = 0
 
@@ -138,6 +143,11 @@ class CryptoBridge:
                     continue
                 self.batches_dispatched += 1
                 self.requests_served += len(reqs)
+                if self.metrics is not None:
+                    self.metrics.counter("bridge_batches_dispatched").inc()
+                    self.metrics.counter("bridge_requests_served").inc(
+                        len(reqs)
+                    )
                 for (_a, fut), res in zip(reqs, results):
                     if not fut.done():
                         fut.set_result(res)

@@ -26,7 +26,7 @@ from __future__ import annotations
 import asyncio
 import random
 import time as _time
-from collections import deque
+from collections import OrderedDict, deque, namedtuple
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -61,6 +61,12 @@ KEYGEN_INBOX_CAP = 4096
 WIRE_RETRY_CAP = 10
 WIRE_RETRY_MAX_QUEUE = 4096
 WIRE_RETRY_TICK_S = 0.25
+# A connection still handshaking after this long has lost its hello or
+# welcome in flight — those frames are sent exactly once, so nothing
+# else would ever heal the link (the wire-chaos plane exposed this:
+# one dropped handshake frame wedged a connection into parking
+# verified traffic forever).  Cull it; outgoing links re-dial.
+HANDSHAKE_TIMEOUT_S = 5.0
 # epoch liveness replay: if no batch commits for a tick, the node
 # re-broadcasts its current-epoch consensus frames (bounded ring)
 EPOCH_OUTBOX_MAX = 8192
@@ -92,6 +98,28 @@ MAX_USER_KEYGENS = 64
 # consensus frames arriving before the DHB exists; senders replay via
 # their epoch-replay loop, so dropping beyond the cap only delays
 IOM_QUEUE_CAP = 8192
+# wire-tier fault ring: the TCP analogue of the sim router's fault_log.
+# Every detection path (bad signature, src spoof, retry abandonment,
+# fast-forward recovery) and every consensus-core fault entry lands
+# here, so the wire-tier observability contract (net/chaos.py) can
+# attribute injected faults exactly like the sim verifier does.
+FAULT_RING_CAP = 1024
+# A node this many epochs behind the certified network frontier is
+# wedged, not slow (live peers stay within ~1 epoch of each other):
+# rebuild the consensus core at the frontier.  The gap must clear 1 —
+# a transient +1 between a committing peer and us is normal pipelining.
+FAST_FORWARD_GAP = 3
+# Disconnect-to-remove-vote grace: reconnect within this window and no
+# removal vote is cast.  Votes persist per voter, so without the grace
+# a season of independent transient resets (the chaos plane's bread
+# and butter) would eventually accumulate a committed removal of a
+# perfectly live validator.
+REMOVE_VOTE_GRACE_S = 5.0
+
+# wire-origin fault entries (ring shape matches the sim router's
+# (node_id, fault-with-.kind) tuples so scenario.attribute_faults
+# consumes both tiers unchanged)
+WireFault = namedtuple("WireFault", ("kind",))
 
 
 @dataclass
@@ -246,10 +274,15 @@ class Hydrabadger:
         uid: Optional[Uid] = None,
         seed: Optional[int] = None,
         recorder=None,
+        chaos=None,
     ):
         self.uid = uid or Uid()
         self.bind = bind
         self.cfg = config or Config()
+        # wire-tier chaos plane (net/chaos.ChaosPlane, duck-typed so
+        # this module never imports net/chaos): when set, every stream
+        # this node opens is wrapped in the plane's fault injector
+        self.chaos = chaos
         # hbtrace: the recorder is THE stamping boundary for this node's
         # consensus cores (handler poll = one stamp); metrics registry
         # is per-node so multi-node harnesses don't cross streams
@@ -304,6 +337,19 @@ class Hydrabadger:
         self._tasks: List[asyncio.Task] = []
         self._share_recovery_task: Optional[asyncio.Task] = None
         self._wire_retry: deque = deque()  # (uid, msg, attempts)
+        # per-frame CUMULATIVE retry attempts: the deque tuples reset to
+        # attempts=0 whenever a dying connection's salvage re-parks a
+        # frame, so a peer that never returns could cycle one frame
+        # through salvage->retry forever.  This bounded LRU remembers
+        # attempts across cycles; at WIRE_RETRY_CAP the frame is dropped
+        # LOUDLY (wire_retry_abandoned + fault ring).
+        self._retry_attempts: OrderedDict = OrderedDict()
+        # wire-tier fault ring (see FAULT_RING_CAP): (nid_hex, WireFault)
+        self.fault_log: deque = deque(maxlen=FAULT_RING_CAP)
+        # (era, epoch, net_state) frontier claims per established peer:
+        # a fast-forward needs f+1 DISTINCT claimants at/above the
+        # target, or one lying peer could wedge us at a forged epoch
+        self._ff_claims: Dict[bytes, tuple] = {}
         # current-epoch outbound consensus frames, replayed by the
         # liveness tick if the epoch stalls (closed-socket in-flight
         # loss is invisible to sender-side salvage; every consensus
@@ -408,24 +454,27 @@ class Hydrabadger:
         ckpt,
         config: Optional[Config] = None,
         seed: Optional[int] = None,
+        chaos=None,
     ) -> "Hydrabadger":
         """Rebuild a node from a NodeCheckpoint: same identity and keys,
         consensus core fast-forwarded to the saved era/epoch.  The node
         rejoins as validator (or observer if the checkpoint has no key
         share) instead of re-running DKG — the resume path the reference
         approximates with start_epoch + JoinPlan (state.rs:298,
-        handler.rs:256-264)."""
-        node = cls(bind, config, uid=Uid(ckpt.uid), seed=seed)
+        handler.rs:256-264).  If the network moved past the saved epoch
+        while the node was down, the certified-frontier fast-forward
+        (_maybe_fast_forward) catches it up after reconnect."""
+        node = cls(bind, config, uid=Uid(ckpt.uid), seed=seed, chaos=chaos)
         node.secret_key = SecretKey.from_bytes(ckpt.secret_key)
         node.public_key = node.secret_key.public_key()
-        node.dhb = ckpt.restore_dhb(
+        node.dhb = node._wrap_dhb(ckpt.restore_dhb(
             encrypt=node.cfg.encrypt,
             coin_mode=node.cfg.coin_mode,
             verify_shares=node.cfg.verify_shares,
             rng=node.rng,
             engine=node.cfg.engine,
             recorder=node.obs,
-        )
+        ))
         node.current_epoch = ckpt.epoch
         node.state = "validator" if ckpt.sk_share else "observer"
         return node
@@ -463,6 +512,38 @@ class Hydrabadger:
             self._tasks.append(asyncio.create_task(self._connect_outgoing(remote)))
         log.info("%s listening on %s", self.uid, self.bind)
 
+    async def crash(self) -> None:
+        """SIGKILL emulation for the chaos harness: tear the node down
+        with NO goodbyes and no graceful pump drain — every socket dies
+        mid-stream exactly as a killed process's would, queued frames
+        and all.  Peers observe reader errors, vote us out or retry,
+        and the node restarts from its last checkpoint
+        (from_checkpoint) to rejoin through the recovery flow.
+
+        One in-process concession: in-flight device futures are
+        settled-and-discarded first, because the CryptoFuture drop
+        ledger is process-global in this emulation while a real SIGKILL
+        takes the whole process's futures down with it."""
+        self._stopped.set()
+        prev, self._kg_prev = self._kg_prev, []
+        for entry in prev:
+            try:
+                entry[3]()  # materialize; effects discarded with the node
+            except Exception:
+                pass
+        if self.dhb is not None:
+            try:
+                self.dhb.drain_async()
+            except Exception:
+                pass
+        if self._server is not None:
+            self._server.close()
+        for peer in list(self.peers.by_addr.values()):
+            peer.wire.close()  # transport down NOW; no sentinel drain
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
     async def stop(self) -> None:
         self._stopped.set()
         # settle any in-flight keygen flushes: device work must never be
@@ -479,10 +560,39 @@ class Hydrabadger:
 
     # -- connection plumbing ------------------------------------------------
 
+    def _new_stream(self, reader, writer) -> WireStream:
+        """Build this node's side of a connection.  With a chaos plane
+        attached the stream is the plane's fault injector (link drops,
+        delays, duplicates, resets, partition holds applied at THIS
+        socket boundary); ByzantineHydrabadger overrides this to mount
+        its signature-corruption plane on top."""
+        if self.chaos is not None:
+            return self.chaos.wrap_stream(
+                reader, writer, self.secret_key, self.cfg.wire_sign,
+                self.uid.bytes,
+            )
+        return WireStream(reader, writer, self.secret_key, self.cfg.wire_sign)
+
+    def _wrap_dhb(self, dhb):
+        """Hook: every path that installs a consensus core routes the
+        instance through here (bootstrap DKG, observer join, checkpoint
+        restore, fast-forward).  The base node is honest — identity;
+        net/chaos.ByzantineHydrabadger wraps the core in the sim's
+        ByzantineNode strategy pipeline so the attack catalog runs over
+        real sockets."""
+        return dhb
+
+    def _note_fault(self, kind: str, counter: Optional[str] = None) -> None:
+        """Record a wire-tier detection: fault ring entry (+ optional
+        counter) — the observables the chaos contract verifies."""
+        if counter is not None:
+            self.metrics.counter(counter).inc()
+        self.fault_log.append((self.uid.bytes.hex()[:8], WireFault(kind)))
+
     async def _on_incoming(self, reader, writer) -> None:
         addr = writer.get_extra_info("peername") or ("?", 0)
         out_addr = OutAddr(addr[0], addr[1])
-        stream = WireStream(reader, writer, self.secret_key, self.cfg.wire_sign)
+        stream = self._new_stream(reader, writer)
         peer = Peer(out_addr, stream, metrics=self.metrics)
         peer.start_pump()
         self.peers.add(peer)
@@ -531,9 +641,7 @@ class Hydrabadger:
         if reader is None:
             log.error("giving up dialling %s", remote)
             return
-        stream = WireStream(
-            reader, writer, self.secret_key, self.cfg.wire_sign
-        )
+        stream = self._new_stream(reader, writer)
         peer = Peer(remote, stream, outgoing=True, metrics=self.metrics)
         peer.start_pump()
         self.peers.add(peer)
@@ -767,6 +875,9 @@ class Hydrabadger:
                 ok = preverified if preverified is not None \
                     else peer.wire.verify(body, sig)
                 if not ok:
+                    self._note_fault(
+                        "wire: bad signature", "wire_sig_rejected"
+                    )
                     log.warning(
                         "bad %s signature from %s", kind, peer.out_addr
                     )
@@ -783,7 +894,7 @@ class Hydrabadger:
                 self._replay_parked(peer)
             if self.state == "disconnected":
                 self.state = "awaiting_more_peers"
-            self._on_net_state(net_state)
+            self._on_net_state(net_state, peer)
             self._after_peer_established(uid, pk)
         elif kind == "hello_from_validator":
             uid_b, host, port, pk_b, net_state = msg.payload
@@ -796,7 +907,7 @@ class Hydrabadger:
                 self.peers.establish(peer)
                 self._replay_parked(peer)
                 self._after_peer_established(uid, pk)
-            self._on_net_state(net_state)
+            self._on_net_state(net_state, peer)
         elif kind == "hello_request_change_add":
             self._on_hello(peer, msg, incoming=False)
         elif kind == "message":
@@ -805,12 +916,14 @@ class Hydrabadger:
             # (the reference asserts this, peer.rs:158): otherwise any
             # connected peer could impersonate any validator
             if bytes(src_b) != peer.uid.bytes:
+                self._note_fault("wire: src spoof", "wire_src_spoof")
                 log.warning("message src spoof from %s", peer.out_addr)
                 return
             self._on_consensus_message(bytes(src_b), payload)
         elif kind == "key_gen":
             src_b, instance_id, payload = msg.payload
             if bytes(src_b) != peer.uid.bytes:
+                self._note_fault("wire: src spoof", "wire_src_spoof")
                 log.warning("key_gen src spoof from %s", peer.out_addr)
                 return
             self._on_key_gen_message(bytes(src_b), tuple(instance_id), payload)
@@ -861,7 +974,7 @@ class Hydrabadger:
                 for kg_msg in self.keygen_outbox:
                     peer.send(kg_msg)
         elif kind == "net_state":
-            self._on_net_state(msg.payload)
+            self._on_net_state(msg.payload, peer)
         elif kind == "transaction":
             # unsigned kind, reachable before the handshake: accept only
             # bounded raw bytes from an established peer.  (bytes() on an
@@ -881,7 +994,7 @@ class Hydrabadger:
         elif kind == "pong":
             pass  # keepalive reply; receipt itself is the signal
 
-    def _on_net_state(self, net_state) -> None:
+    def _on_net_state(self, net_state, peer: Optional[Peer] = None) -> None:
         tag = net_state[0]
         if tag in ("awaiting_more_peers", "generating_keys"):
             peers_info = net_state[1]
@@ -908,6 +1021,173 @@ class Hydrabadger:
             )
             self._become_observer(plan)
             self._discover(peers_info)
+        elif tag == "active":
+            # live consensus already: this gossip is a frontier claim —
+            # the crash/restart recovery signal (see _maybe_fast_forward)
+            self._note_frontier_claim(net_state, peer)
+            self._discover(net_state[7])
+
+    # -- crash/restart recovery: certified epoch fast-forward ---------------
+
+    def _note_frontier_claim(self, net_state, peer: Optional[Peer]) -> None:
+        """Record an established validator's claimed (era, epoch)
+        frontier.  net_state is UNSIGNED (attacker-writable), so no
+        single claim moves us: a fast-forward requires f+1 distinct
+        validator claimants at/above the target epoch — at least one of
+        them honest — or one lying peer could wedge a healthy node at a
+        forged future epoch forever."""
+        if peer is None or peer.uid is None or self.dhb is None:
+            return
+        if peer.uid.bytes not in self.dhb.netinfo.node_ids:
+            return  # only validator claims count toward certification
+        try:
+            # validate the FULL shape up front: a malformed claim that
+            # only failed at adoption time would otherwise sit at the
+            # frontier and permanently block recovery.  The fingerprint
+            # is everything an adoption would trust — era, roster, the
+            # VALIDATORS' identity keys, pk_set, session — so the f+1
+            # certification covers the payload, not just the ordinal
+            # (observer pub_keys entries legitimately differ between
+            # honest peers and are deliberately excluded).
+            (_tag, era, epoch, node_ids, pub_keys, pk_set_b, session,
+             _peers_info) = net_state
+            era, epoch = int(era), int(epoch)
+            roster = tuple(bytes(n) for n in node_ids)
+            pks = {bytes(k): bytes(v) for k, v in pub_keys.items()}
+            fingerprint = (
+                era,
+                roster,
+                tuple((n, pks[n]) for n in roster),
+                bytes(pk_set_b),
+                bytes(session),
+            )
+        except (TypeError, ValueError, IndexError, KeyError):
+            return
+        self._ff_claims[peer.uid.bytes] = (era, epoch, fingerprint)
+        self._maybe_fast_forward()
+
+    def _rebuild_same_era(self, d, epoch: int) -> None:
+        """Rebuild the consensus core at ``epoch`` within our CURRENT
+        era — own keys, own pk_set, our secret share carried over:
+        nothing attacker-supplied.  (No logging in here: the share is
+        live key material.)"""
+        plan = d.join_plan()
+        plan = JoinPlan(
+            era=plan.era,
+            epoch=epoch,
+            node_ids=plan.node_ids,
+            pub_keys=plan.pub_keys,
+            pk_set_bytes=plan.pk_set_bytes,
+            session_id=plan.session_id,
+        )
+        share = d.netinfo.sk_share
+        self.dhb = self._wrap_dhb(
+            DynamicHoneyBadger.from_join_plan(
+                self.uid.bytes,
+                self.secret_key,
+                plan,
+                encrypt=self.cfg.encrypt,
+                coin_mode=self.cfg.coin_mode,
+                verify_shares=self.cfg.verify_shares,
+                rng=self.rng,
+                engine=self.cfg.engine,
+                recorder=self.obs,
+                sk_share=share,
+            )
+        )
+        self.state = "validator" if share is not None else "observer"
+
+    def _certified_frontier(self) -> Optional[tuple]:
+        """The highest (era, epoch) at least f+1 distinct validators
+        claim to have reached — Byzantine-safe in BOTH dimensions: with
+        at most f liars, the (f+1)-th largest epoch within a group of
+        claims sharing one PLAN FINGERPRINT (era, roster, validator
+        identity keys, pk_set, session) is backed by an honest node,
+        and so is the fingerprint itself.  Certifying only the ordinal
+        would let a Byzantine validator ride an honest (era, epoch)
+        with a forged pk_set/roster payload and hijack the recovering
+        node's view.  Returns (era, epoch, fingerprint) of an
+        honest-backed claim, or None."""
+        if self.dhb is None:
+            return None
+        n = len(self.dhb.netinfo.node_ids)
+        f = (n - 1) // 3
+        groups: Dict[tuple, List[tuple]] = {}
+        for claim in self._ff_claims.values():
+            groups.setdefault(claim[2], []).append(claim)
+        best = None
+        for members in groups.values():
+            if len(members) < f + 1:
+                continue
+            members = sorted(
+                members, key=lambda c: (c[0], c[1]), reverse=True
+            )
+            candidate = members[f]  # (f+1)-th largest epoch in-group
+            if best is None or (candidate[0], candidate[1]) > (
+                best[0], best[1]
+            ):
+                best = candidate
+        return best
+
+    def _maybe_fast_forward(self) -> None:
+        """Re-adopt the certified network frontier when wedged behind it.
+
+        A validator restarted from a checkpoint (or stranded by a long
+        partition) resumes at a stale epoch; the network has moved on
+        and nobody re-serves concluded epochs' traffic, so without this
+        it would stall forever while the honest quorum keeps committing.
+        When f+1 validators claim an epoch >= ours + FAST_FORWARD_GAP:
+
+          * same era — rebuild the consensus core from OUR OWN join
+            plan (own keys, own pk_set: nothing attacker-supplied) at
+            the certified epoch, carrying our secret share over, so we
+            come back as a validator and catch the in-flight epoch via
+            the peers' welcome-back replay;
+          * later era — our share is stale; adopt the CERTIFIED plan
+            (built from the f+1-backed fingerprint, never one
+            claimant's raw payload) as an observer and recover the new
+            era's share through the committed-transcript flow
+            (_maybe_recover_share)."""
+        d = self.dhb
+        cert = self._certified_frontier()
+        if d is None or cert is None:
+            return
+        era, epoch, fingerprint = cert
+        if era < d.era or (era == d.era and epoch < d.epoch + FAST_FORWARD_GAP):
+            return
+        # settle in-flight device work before discarding the old core —
+        # a dropped CryptoFuture is a loud process-global failure
+        try:
+            d.drain_async()
+        except Exception:
+            log.exception("drain_async failed during fast-forward")
+        if era == d.era:
+            self._rebuild_same_era(d, int(epoch))
+        else:
+            _era, roster, validator_pks, pk_set_b, session = fingerprint
+            self._become_observer(
+                JoinPlan(
+                    era=int(era),
+                    epoch=int(epoch),
+                    node_ids=roster,
+                    pub_keys=dict(validator_pks),
+                    pk_set_bytes=pk_set_b,
+                    session_id=session,
+                )
+            )
+            self._maybe_recover_share()
+        old_epoch, self.current_epoch = self.current_epoch, int(epoch)
+        # frames of concluded epochs would only cost every receiver a
+        # signature check on our next stall replay
+        self._epoch_outbox.clear()
+        self._last_progress_t = _time.monotonic()
+        self._replay_backoff = 1.0
+        self._note_fault("wire: fast-forward", "node_fast_forwards")
+        log.info(
+            "%s fast-forwarded era %d epoch %d -> era %d epoch %d "
+            "(certified by f+1 peers)",
+            self.uid, d.era, old_epoch, era, int(epoch),
+        )
 
     def _discover(self, peers_info) -> None:
         """Dial newly-learned peers (handler.rs:377-393).
@@ -991,6 +1271,28 @@ class Hydrabadger:
             # active network: vote the newcomer in (handler.rs:77-88)
             if self.dhb.is_validator and uid.bytes not in self.dhb.netinfo.node_ids:
                 self.dhb.vote_to_add(uid.bytes, pk)
+            elif uid.bytes in self.dhb.netinfo.node_ids:
+                # welcome-back replay: a fellow validator (re)connecting
+                # mid-epoch missed whatever we sent before this link
+                # existed — a crash/restart or a chaos-plane reset.  Our
+                # epoch outbox holds exactly the current epoch's frames;
+                # every consensus handler is duplicate-tolerant, so
+                # replaying them to the newcomer is unconditionally safe
+                # and is what lets a recovered node catch the in-flight
+                # epoch instead of stalling until the next fast-forward.
+                target = self.peers.get_by_uid(uid)
+                if target is not None and self._epoch_outbox:
+                    n = 0
+                    for _epoch, tgt, msg in list(self._epoch_outbox):
+                        if tgt is None or tgt == uid:
+                            target.send(msg)
+                            n += 1
+                    if n:
+                        self.metrics.counter("welcome_back_replays").inc()
+                        log.info(
+                            "%s replayed %d epoch frames to rejoining %s",
+                            self.uid, n, uid,
+                        )
             return
         if (
             self.state == "awaiting_more_peers"
@@ -1194,7 +1496,7 @@ class Hydrabadger:
         if machine.instance_id == ("builtin",):
             node_ids = sorted(machine.kg.pub_keys.keys())
             netinfo = NetworkInfo(self.uid.bytes, node_ids, pk_set, sk_share)
-            self.dhb = DynamicHoneyBadger(
+            self.dhb = self._wrap_dhb(DynamicHoneyBadger(
                 self.uid.bytes,
                 self.secret_key,
                 netinfo,
@@ -1207,7 +1509,7 @@ class Hydrabadger:
                 rng=self.rng,
                 engine=self.cfg.engine,
                 recorder=self.obs,
-            )
+            ))
             self.key_gen = None
             # keep the outbox: stragglers behind a healing link still need
             # the transcript (served on their net_state_request gossip)
@@ -1268,7 +1570,7 @@ class Hydrabadger:
     # -- consensus plumbing ---------------------------------------------------
 
     def _become_observer(self, plan: JoinPlan) -> None:
-        self.dhb = DynamicHoneyBadger.from_join_plan(
+        self.dhb = self._wrap_dhb(DynamicHoneyBadger.from_join_plan(
             self.uid.bytes,
             self.secret_key,
             plan,
@@ -1278,7 +1580,10 @@ class Hydrabadger:
             rng=self.rng,
             engine=self.cfg.engine,
             recorder=self.obs,
-        )
+        ))
+        # chaos-contract observable: a crash/restart that was voted out
+        # and re-added recovers through one (or more) of these adoptions
+        self.metrics.counter("observer_adoptions").inc()
         self.state = "observer"
         self._last_progress_t = _time.monotonic()  # see _maybe_finish_keygen
         log.info("%s observer at era %d epoch %d", self.uid, plan.era, plan.epoch)
@@ -1318,6 +1623,13 @@ class Hydrabadger:
                 self._epoch_outbox.append((self.current_epoch, None, msg))
                 self.peers.wire_to_all(msg)
         for fault in step.fault_log:
+            # mirror the cores' fault entries into the wire-tier ring:
+            # the chaos contract attributes them exactly like the sim
+            # verifier attributes router.faults (same kind strings)
+            self.metrics.counter("consensus_faults").inc()
+            self.fault_log.append(
+                (str(fault.node_id)[:16], WireFault(fault.kind))
+            )
             log.debug("fault: %s %s", str(fault.node_id)[:16], fault.kind)
         for batch in step.output:
             if isinstance(batch, DhbBatch):
@@ -1538,17 +1850,58 @@ class Hydrabadger:
             )
 
     def _on_disconnect(self, peer: Peer) -> None:
+        if peer.state == "established":
+            # the observable for injected connection resets (and real
+            # link failures): a torn-down authenticated connection
+            self.metrics.counter("peer_disconnects").inc()
         self.peers.remove(peer)
         self._salvage_unsent(peer)
         peer.close()
+        if peer.uid is not None:
+            self._ff_claims.pop(peer.uid.bytes, None)
         if (
             peer.uid is not None
             and self.dhb is not None
             and self.dhb.is_validator
             and peer.uid.bytes in self.dhb.netinfo.node_ids
+            and not self._stopped.is_set()
         ):
-            # vote the dead validator out (handler.rs:397-426)
-            self.dhb.vote_to_remove(peer.uid.bytes)
+            # re-dial a fellow validator whose link died: a connection
+            # reset (chaos plane, NAT flap, crash) is otherwise healed
+            # only by the next discovery gossip, which a healthy
+            # network never sends.  Both ends re-dialling is fine —
+            # _resolve_duplicate tie-breaks, exactly the path this
+            # exercises; a really-dead peer costs one bounded-backoff
+            # dial task.
+            if peer.in_addr is not None:
+                self._tasks.append(
+                    asyncio.create_task(
+                        self._connect_outgoing(
+                            OutAddr(peer.in_addr.host, peer.in_addr.port)
+                        )
+                    )
+                )
+            # vote the dead validator out (handler.rs:397-426) — after a
+            # grace window: votes are remembered per voter, so voting on
+            # EVERY transient reset would let independent blips
+            # accumulate into a committed removal of a live validator
+            self._tasks.append(
+                asyncio.create_task(self._vote_remove_later(peer.uid))
+            )
+
+    async def _vote_remove_later(self, uid: Uid) -> None:
+        await asyncio.sleep(REMOVE_VOTE_GRACE_S)
+        if self._stopped.is_set():
+            return
+        p = self.peers.get_by_uid(uid)
+        if p is not None and p.state == "established":
+            return  # the peer came back: a blip, not a death
+        if (
+            self.dhb is not None
+            and self.dhb.is_validator
+            and uid.bytes in self.dhb.netinfo.node_ids
+        ):
+            self.dhb.vote_to_remove(uid.bytes)
 
     def _salvage_unsent(self, peer: Peer) -> None:
         """Re-park frames still queued on a dying connection into the
@@ -1559,12 +1912,125 @@ class Hydrabadger:
         for msg in peer.drain_unsent():
             self._queue_wire_retry(peer.uid, msg)
 
+    def _retry_key(self, uid: Uid, msg: WireMessage):
+        """Stable identity of one targeted frame for the cumulative
+        attempt ledger.  Targeted retries are consensus frames (tuples
+        of bytes/ints — hashable); anything unhashable falls back to
+        per-cycle accounting only."""
+        try:
+            key = (uid.bytes, msg)
+            hash(key)
+            return key
+        except TypeError:
+            return None
+
+    def _abandon_retry(self, uid: Uid, msg: WireMessage, quiet: bool = False) -> None:
+        """Per-frame retry budget exhausted: drop LOUDLY — counter +
+        fault ring entry (the chaos contract's declared observable for
+        link faults that outlive every retry) + warning.  ``quiet``
+        marks a refused RE-park of an already-abandoned frame (epoch
+        replay re-offering it every stall tick): counted and ringed the
+        same, logged at debug so the warning stream stays readable."""
+        # keep the exhausted entry: a re-park of the same frame (epoch
+        # replay, another salvage cycle) is refused outright while the
+        # ledger remembers it; LRU eviction eventually grants a fresh
+        # budget, so a much-later legitimate resend is not starved
+        self._note_attempts(self._retry_key(uid, msg), WIRE_RETRY_CAP)
+        self._note_fault("wire: retry abandoned", "wire_retry_abandoned")
+        # legacy name, kept incrementing so existing soak/bench row
+        # consumers see the same signal under the old spelling too
+        self.metrics.counter("wire_retry_dropped").inc()
+        (log.debug if quiet else log.warning)(
+            "abandoning targeted frame to %s after %d attempts",
+            uid,
+            WIRE_RETRY_CAP,
+        )
+
     def _queue_wire_retry(self, uid: Uid, msg: WireMessage) -> None:
         """Park an undeliverable targeted frame for the retry tick
-        (handler.rs:660-670 semantics; bounded, oldest dropped first)."""
+        (handler.rs:660-670 semantics; bounded, oldest dropped first).
+
+        Attempts are CUMULATIVE across salvage cycles: a frame salvaged
+        off a dying connection re-enters here with its prior attempt
+        count intact (the `_retry_attempts` ledger), so a peer that
+        never returns cannot cycle one frame through
+        salvage -> retry -> salvage forever — after WIRE_RETRY_CAP total
+        attempts it is abandoned loudly instead."""
+        key = self._retry_key(uid, msg)
+        attempts = 0
+        if key is not None:
+            attempts = self._retry_attempts.get(key, 0)
+            if attempts >= WIRE_RETRY_CAP:
+                self._abandon_retry(uid, msg, quiet=True)
+                return
+            # bounded ledger: oldest tracked frames evict beyond the
+            # queue's own ceiling (they lose cross-cycle memory only)
+            self._note_attempts(key, attempts)
         if len(self._wire_retry) >= WIRE_RETRY_MAX_QUEUE:
             self._wire_retry.popleft()
-        self._wire_retry.append((uid, msg, 0))
+        self._wire_retry.append((uid, msg, attempts))
+
+    def _note_attempts(self, key, attempts: int) -> None:
+        if key is None:
+            return
+        self._retry_attempts[key] = attempts
+        self._retry_attempts.move_to_end(key)
+        while len(self._retry_attempts) > WIRE_RETRY_MAX_QUEUE:
+            self._retry_attempts.popitem(last=False)
+
+    def _wire_retry_tick(self) -> None:
+        """One drain of the retry queue (factored from the loop so the
+        attempt-budget schedule is unit-testable without sockets).
+
+        Only FAILED deliveries charge the cumulative budget: a frame
+        repeatedly salvaged off flapping-but-returning links keeps
+        getting re-offered (each salvage cycle proves the peer came
+        back), while a frame whose every attempt finds no established
+        peer burns through WIRE_RETRY_CAP and is abandoned loudly."""
+        pending, self._wire_retry = self._wire_retry, deque()
+        for uid, msg, attempts in pending:
+            if self.peers.wire_to(uid, msg):
+                # handed to an established peer's pump; if THAT
+                # connection dies pre-flush, salvage re-parks the frame
+                # with its failed-attempt count intact (the ledger)
+                continue
+            attempts += 1
+            key = self._retry_key(uid, msg)
+            if attempts < WIRE_RETRY_CAP:
+                self._note_attempts(key, attempts)
+                self._wire_retry.append((uid, msg, attempts))
+            else:
+                self._abandon_retry(uid, msg)
+
+    def _cull_stalled_handshakes(self) -> None:
+        """Abort connections wedged in "handshaking" past the timeout.
+
+        Hello/welcome frames are sent exactly once; a lossy link (or
+        the chaos plane) that eats one leaves the connection parking
+        verified frames forever while both ends believe it is merely
+        slow.  Aborting errors both pumps; outgoing links re-dial
+        (their out_addr IS the remote's listener), incoming ones are
+        re-dialled by the remote's own cull."""
+        now = _time.monotonic()
+        for peer in list(self.peers.by_addr.values()):
+            if (
+                peer.state != "handshaking"
+                or now - peer.born < HANDSHAKE_TIMEOUT_S
+            ):
+                continue
+            self.metrics.counter("handshake_timeouts").inc()
+            log.warning(
+                "culling connection to %s: handshake stalled %.1fs",
+                peer.out_addr,
+                now - peer.born,
+            )
+            peer.abort()
+            if peer.outgoing and not self._stopped.is_set():
+                self._tasks.append(
+                    asyncio.create_task(
+                        self._connect_outgoing(peer.out_addr)
+                    )
+                )
 
     async def _wire_retry_loop(self) -> None:
         """Re-attempt targeted frames to not-yet/re-connected peers.
@@ -1573,24 +2039,19 @@ class Hydrabadger:
         each handler poll and re-queues failures up to 10 attempts
         (handler.rs:660-670, peer.rs:581-600, cap mod.rs:17); here a
         timed tick drains ours so a link flap mid-epoch does not lose
-        RBC shards the protocol assumes delivered."""
+        RBC shards the protocol assumes delivered.  The tick doubles as
+        the handshake-stall sweep."""
         while True:
             await asyncio.sleep(WIRE_RETRY_TICK_S)
-            if not self._wire_retry:
-                continue
-            pending, self._wire_retry = self._wire_retry, deque()
-            for uid, msg, attempts in pending:
-                if self.peers.wire_to(uid, msg):
-                    continue
-                if attempts + 1 < WIRE_RETRY_CAP:
-                    self._wire_retry.append((uid, msg, attempts + 1))
-                else:
-                    self.metrics.counter("wire_retry_dropped").inc()
-                    log.warning(
-                        "dropping targeted frame to %s after %d attempts",
-                        uid,
-                        WIRE_RETRY_CAP,
-                    )
+            self._cull_stalled_handshakes()
+            # prune completed dial/grace-vote tasks: every disconnect
+            # spawns a couple, and only _discover used to sweep them —
+            # rare in steady state, so a long chaos run would otherwise
+            # retain thousands of finished task objects
+            if any(t.done() for t in self._tasks):
+                self._tasks = [t for t in self._tasks if not t.done()]
+            if self._wire_retry:
+                self._wire_retry_tick()
 
     def _replay_due(self, now: float) -> bool:
         """The replay-pacing gate, factored out of the loop so the
@@ -1657,6 +2118,11 @@ class Hydrabadger:
                     self.peers.wire_to_all(msg)
                 elif not self.peers.wire_to(target, msg):
                     self._queue_wire_retry(target, msg)
+            # stall watchdog: a wedged node may be BEHIND, not just
+            # unlucky — gossip for frontier claims so the certified
+            # fast-forward (crash/restart recovery) can trigger.  The
+            # replies also re-teach us any peers we lost.
+            self.peers.wire_to_all(WireMessage("net_state_request", None))
 
     async def _keepalive_loop(self) -> None:
         """Periodic ping to every established peer (wire `ping`/`pong`).

@@ -9,6 +9,7 @@ state per peer (peer.rs:219-236), and broadcast helpers
 from __future__ import annotations
 
 import asyncio
+import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional
 
@@ -46,6 +47,12 @@ class Peer:
     # same race in its wire retry queue (handler.rs:660-670)
     parked: List[tuple] = field(default_factory=list)
     parked_bytes: int = 0  # cumulative body bytes parked (budgeted)
+    # when this connection was opened: a peer stuck in "handshaking"
+    # past the node's handshake timeout (a hello/welcome lost in
+    # flight — chaos plane, lossy link) is culled and re-dialled,
+    # because handshake frames are sent exactly once and nothing else
+    # retries them
+    born: float = field(default_factory=_time.monotonic)
     # obs/metrics registry of the owning node (set when the node adopts
     # the connection); per-frame tx counters + overflow events land here
     metrics: Optional[object] = None
@@ -55,6 +62,9 @@ class Peer:
         self.in_addr = in_addr
         self.pk = pk
         self.wire.peer_pk = pk
+        # chaos plane link identity: once the peer authenticates, its
+        # stream resolves per-link fault policies by node id
+        self.wire.peer_uid = uid.bytes
         self.state = "established"
 
     async def _pump(self) -> None:

@@ -108,14 +108,34 @@ class WireStream:
         self.secret_key = secret_key
         self.sign_frames = sign_frames
         self.peer_pk: Optional[PublicKey] = None  # set after handshake
+        # the authenticated peer's node id, installed by Peer.establish
+        # alongside peer_pk: the chaos plane (net/chaos.py) resolves
+        # per-link fault policies by it, and it is generally useful for
+        # attributing a stream to the node behind it
+        self.peer_uid: Optional[bytes] = None
 
-    async def send(self, msg: WireMessage) -> None:
+    def _frame(self, msg: WireMessage) -> bytes:
+        """Sign + length-prefix one message into its on-wire bytes.
+        Factored from send() so fault-injecting streams (net/chaos.py)
+        can build — and tamper with — a frame without re-implementing
+        the codec/signing contract."""
         body = msg.encode()
         sig = self.secret_key.sign(body).to_bytes() if self.sign_frames else b""
+        return self._assemble(body, sig)
+
+    @staticmethod
+    def _assemble(body: bytes, sig: bytes) -> bytes:
+        # hblint: disable=secret-taint -- `sig` is a BLS SIGNATURE (public wire data derived via sign(); the reference ships it in every SignedWireMessage, lib.rs:350-355), not key material; the secret key itself never reaches this function
         frame = codec.encode((body, sig))
         if len(frame) > MAX_FRAME:
             raise WireError("frame too large")
-        self.writer.write(len(frame).to_bytes(4, "big") + frame)
+        return len(frame).to_bytes(4, "big") + frame
+
+    async def send(self, msg: WireMessage) -> None:
+        # one write() call per frame: concurrent senders (the chaos
+        # plane's delayed-release tasks) interleave at frame, never
+        # byte, granularity
+        self.writer.write(self._frame(msg))
         await self.writer.drain()
 
     async def recv(self) -> Tuple[WireMessage, bytes, bytes]:

@@ -56,6 +56,32 @@ DEVICE_OVERLAP_HAS_DEVICE = "device_overlap_has_device"
 BYZ_INJECTED_PREFIX = "byz_injected_"
 BYZ_FAULTS_PREFIX = "byz_faults_"
 
+# Wire-tier detection counters (net/node.py fault paths).  Every name is
+# fixed here so the wire-tier observability contract
+# (net/chaos.py:WIRE_FAULT_OBSERVABLES) and the detection sites bind to
+# one spelling — a renamed counter would silently void the contract:
+#
+#   WIRE_SIG_REJECTED — a verified-kind frame failed its BLS signature
+#       check (the observable for in-flight signature corruption).
+#   WIRE_SRC_SPOOF — a message/key_gen frame claimed a source other
+#       than the authenticated connection peer.
+#   PEER_DISCONNECTS — established connections torn down (the
+#       observable for injected connection resets).
+#   WIRE_RETRY_ABANDONED — a targeted frame dropped LOUDLY after its
+#       per-frame retry budget (WIRE_RETRY_CAP attempts, cumulative
+#       across salvage cycles) was exhausted.
+#   NODE_FAST_FORWARDS — a stranded validator/observer re-adopted the
+#       network's certified (era, epoch) frontier (the crash/restart
+#       recovery observable).
+#   BYZ_DUP_SUPPRESSED — duplicate frames absorbed by the per-sender
+#       LRU before costing a proof re-verification (sim handler path).
+WIRE_SIG_REJECTED = "wire_sig_rejected"
+WIRE_SRC_SPOOF = "wire_src_spoof"
+PEER_DISCONNECTS = "peer_disconnects"
+WIRE_RETRY_ABANDONED = "wire_retry_abandoned"
+NODE_FAST_FORWARDS = "node_fast_forwards"
+BYZ_DUP_SUPPRESSED = "byz_dup_suppressed"
+
 
 class Counter:
     __slots__ = ("value",)

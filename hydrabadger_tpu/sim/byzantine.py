@@ -333,7 +333,9 @@ class ByzantineNode:
     """Wraps an honest QueueingHoneyBadger/DynamicHoneyBadger; every
     outgoing Step passes through the strategy pipeline.  All other
     attributes delegate, so the sim drives it exactly like the honest
-    node it impersonates."""
+    node it impersonates.  The wire tier mounts the same wrapper over a
+    real ``net/`` node's consensus core (net/chaos.ByzantineHydrabadger),
+    so one strategy catalog attacks both planes."""
 
     def __init__(self, node, strategies: Tuple[Strategy, ...], log=None):
         self._node = node
@@ -386,4 +388,20 @@ class ByzantineNode:
         node = self.__dict__.get("_node")
         if node is None:  # mid-unpickle: nothing to delegate to yet
             raise AttributeError(name)
-        return getattr(node, name)
+        attr = getattr(node, name)
+        if name == "drain_async":
+            # tick-boundary settle of in-flight device work: its step
+            # is wire traffic like any other (the TCP runtime dispatches
+            # it onto real sockets), so it travels the strategy pipeline
+            # too.  Resolved HERE, not as a method, so cores without the
+            # hbasync plane (QueueingHoneyBadger) keep raising
+            # AttributeError and the sim's feature detection still works.
+            # Only steps CARRYING traffic are mutated: traffic-minting
+            # strategies (replay_flood) appending to every empty drain
+            # would turn the router's quiescence drain into a livelock.
+            def _drain():
+                step = attr()
+                return self._mutate(step) if step.messages else step
+
+            return _drain
+        return attr

@@ -89,14 +89,18 @@ FAULT_OBSERVABLES: Dict[str, ObsSpec] = {
     ),
     T.BYZ_REPLAY_FLOOD: ObsSpec(
         # replayed cross-sender frames fail the per-sender proof/index
-        # checks or collide with the sender's real messages
+        # checks or collide with the sender's real messages; repeats of
+        # an already-replayed frame are absorbed by the per-sender
+        # duplicate LRU (network._handle) before reaching a core, so
+        # the suppression counter is a declared observable too
         fault_any=(
             "broadcast: invalid",
             "broadcast: conflicting",
             "broadcast: Value from non-proposer",
             "threshold_decrypt: conflicting share",
             "malformed message",
-        )
+        ),
+        counters=("byz_dup_suppressed",),
     ),
     T.BYZ_WITHHELD_SHARE: _self_counter(T.BYZ_WITHHELD_SHARE),
     T.BYZ_LINK_DROP: _self_counter(T.BYZ_LINK_DROP),
@@ -332,7 +336,7 @@ class ScenarioAdversary:
 # -- the observability verifier ----------------------------------------------
 
 
-def _attribute(fault_kind: str, injected) -> Optional[str]:
+def _attribute(fault_kind: str, injected, registry=None) -> Optional[str]:
     """Attribute ONE fault_log entry to at most ONE taxonomy kind.
 
     The substring families overlap (a replayed frame and an equivocating
@@ -343,9 +347,10 @@ def _attribute(fault_kind: str, injected) -> Optional[str]:
     prefer a kind the scenario actually injected, then the most specific
     (longest) matching substring, with sorted-kind order as the final
     deterministic tie-break."""
+    registry = FAULT_OBSERVABLES if registry is None else registry
     best = None
-    for kind in sorted(FAULT_OBSERVABLES):
-        for sub in FAULT_OBSERVABLES[kind].fault_any:
+    for kind in sorted(registry):
+        for sub in registry[kind].fault_any:
             if sub in fault_kind:
                 rank = (kind in injected, len(sub))
                 if best is None or rank > best[0]:
@@ -353,28 +358,30 @@ def _attribute(fault_kind: str, injected) -> Optional[str]:
     return None if best is None else best[1]
 
 
-def attribute_faults(faults, injected=frozenset()) -> Dict[str, int]:
+def attribute_faults(faults, injected=frozenset(), registry=None) -> Dict[str, int]:
     """Exclusive per-kind counts of the run's fault_log entries (each
-    entry counted once — ``sum(values)`` never exceeds ``len(faults)``)."""
+    entry counted once — ``sum(values)`` never exceeds ``len(faults)``).
+    ``registry`` selects the observability registry (default: the sim
+    tier's FAULT_OBSERVABLES; the wire tier passes its own)."""
     counts: Dict[str, int] = {}
     for _nid, f in faults:
-        kind = _attribute(f.kind, injected)
+        kind = _attribute(f.kind, injected, registry)
         if kind is not None:
             counts[kind] = counts.get(kind, 0) + 1
     return counts
 
 
-def fold_fault_counters(faults, metrics, injected=frozenset()) -> None:
+def fold_fault_counters(faults, metrics, injected=frozenset(), registry=None) -> None:
     """Classify the run's fault_log entries by taxonomy kind and fold
     them into ``byz_faults_*`` counters — the mechanical bridge from
     free-form core fault strings to the bounded counter family the
     soak/bench rows surface.  Pass the injected kinds so ambiguous
     entries resolve toward attacks that actually ran."""
-    for kind, n in attribute_faults(faults, injected).items():
+    for kind, n in attribute_faults(faults, injected, registry).items():
         metrics.counter(BYZ_FAULTS_PREFIX + kind).inc(n)
 
 
-def verify_observability(log: InjectionLog, faults, metrics) -> List[str]:
+def verify_observability(log: InjectionLog, faults, metrics, registry=None) -> List[str]:
     """The fault-observability contract, checked mechanically.
 
     For every fault kind the scenario injected, at least one registered
@@ -383,16 +390,19 @@ def verify_observability(log: InjectionLog, faults, metrics) -> List[str]:
     gauge's high-water.  Returns human-readable violations (empty =
     contract holds); an injected kind with NO registry entry is itself
     a violation — new attacks cannot ship without an observability
-    story."""
+    story.  The same verifier serves both tiers: the sim passes the
+    default FAULT_OBSERVABLES, the wire tier (net/chaos.py) its
+    WIRE_FAULT_OBSERVABLES."""
+    registry = FAULT_OBSERVABLES if registry is None else registry
     violations: List[str] = []
     # exclusive attribution: a fault entry satisfies ONE kind, so a
     # replay-induced "conflicting share" cannot stand in for garbage
     # shares that sailed through verification undetected
-    attributed = attribute_faults(faults, injected=set(log.counts))
+    attributed = attribute_faults(faults, injected=set(log.counts), registry=registry)
     for kind, injected in sorted(log.counts.items()):
         if injected <= 0:
             continue
-        spec = FAULT_OBSERVABLES.get(kind)
+        spec = registry.get(kind)
         if spec is None:
             violations.append(
                 f"injected fault kind {kind!r} has no FAULT_OBSERVABLES "
@@ -416,8 +426,8 @@ def verify_observability(log: InjectionLog, faults, metrics) -> List[str]:
     return violations
 
 
-def assert_observability(log: InjectionLog, faults, metrics) -> None:
-    violations = verify_observability(log, faults, metrics)
+def assert_observability(log: InjectionLog, faults, metrics, registry=None) -> None:
+    violations = verify_observability(log, faults, metrics, registry)
     if violations:
         raise AssertionError(
             "scenario observability contract violated:\n  "

@@ -214,6 +214,20 @@ def byz_soak(epochs: int = 200, n_nodes: int = 4,
     }
 
 
+def wire_chaos_soak(epochs: int = 8) -> Dict:
+    """Wire-tier chaos gate (ROADMAP item 5's TCP headroom): the
+    canonical 4-node full-crypto cluster with f=1 Byzantine peer, link
+    faults (drop/dup/delay/reset + a partition window), in-flight
+    signature corruption, and one crash/restart recovered from a stale
+    checkpoint — asserting honest-quorum liveness, byte-identical
+    recovery and the wire observability contract (net/chaos.py).  The
+    row carries the two headline robustness metrics: the longest
+    commit gap under fault and the recovery catch-up time."""
+    from ..net.chaos import run_chaos_cluster
+
+    return run_chaos_cluster(epochs=epochs, base_port=3870)
+
+
 def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
     """4-node localhost cluster, DEFAULT (full) crypto tier, to
     `epochs` committed batches with queue/RSS bounds sampled live."""
@@ -348,22 +362,35 @@ def main(argv=None) -> int:
                    "the full-crypto attacked tier is the slowest)")
     p.add_argument("--skip-tcp", action="store_true")
     p.add_argument("--skip-byz", action="store_true")
+    p.add_argument("--skip-wire", action="store_true")
     p.add_argument("--byz-only", action="store_true",
                    help="run ONLY the Byzantine liveness-under-attack "
                    "tier (the scripts/test-all SOAK gate)")
+    p.add_argument("--wire-only", action="store_true",
+                   help="run ONLY the wire-tier chaos gate (TCP link "
+                   "faults + Byzantine peer + crash/restart; the other "
+                   "scripts/test-all gate)")
+    p.add_argument("--wire-epochs", type=int, default=8,
+                   help="wire-chaos tier committed-epoch target "
+                   "(full-crypto TCP: each costs ~2 s)")
     p.add_argument("--out", default="SOAK.json")
     args = p.parse_args(argv)
 
     results = []
-    if not args.byz_only:
+    only = args.byz_only or args.wire_only
+    if not only:
         r = sim_soak(args.epochs)
         print(json.dumps(r), flush=True)
         results.append(r)
-    if not args.skip_byz:
+    if not args.skip_byz and not args.wire_only:
         r = byz_soak(args.byz_epochs or max(20, args.epochs // 5))
         print(json.dumps(r), flush=True)
         results.append(r)
-    if not args.skip_tcp and not args.byz_only:
+    if not args.skip_wire and not args.byz_only:
+        r = wire_chaos_soak(args.wire_epochs)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if not args.skip_tcp and not only:
         r = tcp_soak(args.tcp_epochs or args.epochs)
         print(json.dumps(r), flush=True)
         results.append(r)

@@ -596,8 +596,9 @@ def test_callgraph_resolves_methods_and_engine_dispatch():
     assert tgt and "crypto/engine.py::CpuEngine.verify_batch" in tgt[0].targets
     # a known module's unknown symbol stays unresolved (codec.encode is
     # an alias assignment — guessing ReedSolomon.encode here once
-    # cross-polluted the secret pass)
-    sites = g.calls_by_caller["net/wire.py::WireStream.send"]
+    # cross-polluted the secret pass); it lives in the frame assembler
+    # since the round-8 chaos-stream refactor split send()
+    sites = g.calls_by_caller["net/wire.py::WireStream._assemble"]
     tgt = [s for s in sites if s.dotted == "codec.encode"]
     assert tgt and tgt[0].targets == []
     # inheritance: TpuEngine inherits verify_batch from CpuEngine

@@ -190,7 +190,16 @@ def test_self_counting_kinds_observed_via_their_counter():
 
 
 def test_every_taxonomy_kind_has_an_observables_entry():
-    assert set(FAULT_OBSERVABLES) == set(T.BYZ_KINDS)
+    """No attack kind ships without an observability story: the sim
+    registry covers every sim-injectable kind, and the wire registry
+    (a superset: the socket boundary can inject everything plus
+    resets, signature corruption and crashes) covers the full
+    taxonomy."""
+    from hydrabadger_tpu.net.chaos import WIRE_FAULT_OBSERVABLES
+
+    wire_only = {T.BYZ_LINK_RESET, T.BYZ_SIG_CORRUPT, T.BYZ_CRASH}
+    assert set(FAULT_OBSERVABLES) == set(T.BYZ_KINDS) - wire_only
+    assert set(WIRE_FAULT_OBSERVABLES) == set(T.BYZ_KINDS)
 
 
 # -- liveness under attack ---------------------------------------------------
@@ -385,5 +394,43 @@ def test_attack_scenario_16node_liveness():
     assert m.agreement_ok
     assert m.epochs_done == 2
     assert len(net.honest_ids) == 11
+    net.verify_scenario()
+    net.shutdown()
+
+
+# -- per-sender duplicate-frame LRU (round-8 satellite) -----------------------
+
+
+def test_duplicate_frames_suppressed_per_sender():
+    """An identical (sender, message) re-delivery is absorbed before
+    the core re-verifies it — counted, and distinct senders replaying
+    the same bytes do not collide in each other's LRU."""
+    net = SimNetwork(SimConfig(n_nodes=4, epochs=1, seed=5))
+    me, a, b = net.ids[0], net.ids[1], net.ids[2]
+    msg = ("hb", 0, ("cs", 1, ("bc_probe", b"payload")))
+    first = net._handle(me, a, msg)
+    assert first is not None  # delivered to the core (Step, maybe empty)
+    assert net._handle(me, a, msg) is None  # suppressed
+    assert net.metrics.counter("byz_dup_suppressed").value == 1
+    # a DIFFERENT sender replaying the same bytes is not a duplicate
+    assert net._handle(me, b, msg) is not None
+    assert net.metrics.counter("byz_dup_suppressed").value == 1
+
+
+def test_duplicate_lru_bounded_per_sender():
+    net = SimNetwork(SimConfig(n_nodes=4, epochs=1, seed=5))
+    me, a = net.ids[0], net.ids[1]
+    cap = net.DUP_LRU_PER_SENDER
+    for i in range(cap + 10):
+        net._handle(me, a, ("hb", 0, ("probe", i)))
+    assert len(net._dup_seen[me][a]) == cap
+
+
+def test_duplicate_suppression_preserves_liveness_and_agreement():
+    """The replay-heavy attack scenario still commits in agreement with
+    the LRU absorbing repeat replays, and the suppression counter is a
+    declared replay_flood observable."""
+    net, m = _run_attack(4, 4, seed=29)
+    assert m.agreement_ok and m.epochs_done >= 4
     net.verify_scenario()
     net.shutdown()

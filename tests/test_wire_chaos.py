@@ -1,0 +1,522 @@
+"""Wire-tier chaos plane (net/chaos.py): link-fault injection at the
+socket boundary, the ported fault-observability contract, bounded
+wire-retry abandonment, certified-frontier fast-forward, and the
+crash/restart recovery loop — asserted, not eyeballed.
+"""
+import asyncio
+import random
+
+import pytest
+
+from hydrabadger_tpu.consensus import types as T
+from hydrabadger_tpu.net import chaos
+from hydrabadger_tpu.net.chaos import (
+    ChaosPlane,
+    LinkChaos,
+    WireChaosSpec,
+    WirePartition,
+    verify_wire_scenario,
+    wire_spec_from_scenario,
+)
+from hydrabadger_tpu.net.node import (
+    WIRE_RETRY_CAP,
+    Config,
+    Hydrabadger,
+    WireFault,
+)
+from hydrabadger_tpu.net.wire import WireError, WireMessage
+from hydrabadger_tpu.obs.metrics import MetricsRegistry
+from hydrabadger_tpu.sim.scenario import LinkPolicy, ScenarioSpec
+from hydrabadger_tpu.utils.ids import InAddr, OutAddr, Uid
+
+BASE_PORT = 14400
+
+
+def fast_config(**kw):
+    defaults = dict(
+        txn_gen_interval_ms=120,
+        keygen_peer_count=3,
+        encrypt=False,
+        coin_mode="hash",
+        verify_shares=False,
+        wire_sign=False,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def gen_txns(count, nbytes):
+    rng = random.Random()
+    return [
+        bytes(rng.getrandbits(8) for _ in range(max(nbytes, 1)))
+        for _ in range(count)
+    ]
+
+
+async def wait_for(pred, timeout=30.0, interval=0.05):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+# -- plane mechanics ----------------------------------------------------------
+
+
+def test_policy_resolution_first_match_wins():
+    spec = WireChaosSpec(
+        links=(
+            (0, 1, LinkChaos(drop=0.5)),
+            (0, None, LinkChaos(duplicate=0.5)),
+            (None, None, LinkChaos(delay=0.5)),
+        ),
+        default_link=LinkChaos(),
+    )
+    plane = ChaosPlane(spec)
+    assert plane.policy(0, 1).drop == 0.5
+    assert plane.policy(0, 2).duplicate == 0.5
+    assert plane.policy(3, 0).delay == 0.5
+    # unauthenticated destination (-1) matches only wildcards
+    assert plane.policy(0, -1).duplicate == 0.5
+
+
+def test_partition_window_on_wall_clock():
+    spec = WireChaosSpec(
+        partitions=(
+            WirePartition(groups=((0, 1), (2, 3)), start_s=0.0, heal_s=60.0),
+        )
+    )
+    plane = ChaosPlane(spec)
+    # inert until armed
+    assert plane.partition_heal_at(0, 2) is None
+    plane.arm()
+    assert plane.partition_heal_at(0, 2) is not None  # cross-group severed
+    assert plane.partition_heal_at(0, 1) is None  # same side
+    assert plane.partition_heal_at(0, 9) is None  # outside the groups
+    plane.disarm()
+    assert plane.partition_heal_at(0, 2) is None
+
+
+def test_wire_spec_from_scenario_ports_link_taxonomy():
+    sim_spec = ScenarioSpec(
+        name="s",
+        default_link=LinkPolicy(drop=0.1, duplicate=0.2, delay=0.3, delay_max=50),
+        links=((0, 1, LinkPolicy(drop=0.9)),),
+        partitions=(),
+    )
+    wire = wire_spec_from_scenario(sim_spec, tick_s=0.01)
+    assert wire.default_link.drop == 0.1
+    assert wire.default_link.duplicate == 0.2
+    assert wire.default_link.delay == 0.3
+    assert wire.default_link.delay_s == pytest.approx(0.5)
+    assert wire.links[0][2].drop == 0.9
+
+
+@pytest.mark.asyncio
+async def test_chaos_stream_drop_dup_reset_counted():
+    """Frame-level faults over a real localhost socket: drops vanish,
+    duplicates arrive twice, resets kill the connection loudly — and
+    every injection lands in the plane's log."""
+    from hydrabadger_tpu.crypto.threshold import SecretKey
+
+    sk = SecretKey.random(random.Random(1))
+    received = []
+    got = asyncio.Event()
+
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
+                received.append(frame)
+                got.set()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        spec = WireChaosSpec(default_link=LinkChaos(duplicate=1.0))
+        plane = ChaosPlane(spec)
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        stream = plane.wrap_stream(reader, writer, sk, False, b"me")
+        # disarmed: clean pass-through
+        await stream.send(WireMessage("ping", None))
+        plane.arm()
+        # duplicate=1.0: one send, two frames
+        await stream.send(WireMessage("ping", None))
+        await wait_for(lambda: len(received) >= 3)
+        assert len(received) == 3
+        assert plane.log.counts == {T.BYZ_LINK_DUP: 1}
+        # drop=1.0: nothing arrives, injection counted
+        plane.spec = WireChaosSpec(default_link=LinkChaos(drop=1.0))
+        await stream.send(WireMessage("ping", None))
+        assert plane.log.counts[T.BYZ_LINK_DROP] == 1
+        # reset=1.0: the connection dies mid-stream, loudly
+        plane.spec = WireChaosSpec(default_link=LinkChaos(reset=1.0))
+        with pytest.raises(WireError):
+            await stream.send(WireMessage("ping", None))
+        assert plane.log.counts[T.BYZ_LINK_RESET] == 1
+        assert len(received) == 3
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_chaos_stream_delay_reorders_not_loses():
+    """A delayed frame is released by its own task: later frames
+    overtake it (reordering), nothing is lost."""
+    from hydrabadger_tpu.crypto.threshold import SecretKey
+
+    sk = SecretKey.random(random.Random(2))
+    received = []
+
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
+                received.append(frame)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        plane = ChaosPlane(
+            WireChaosSpec(default_link=LinkChaos(delay=1.0, delay_s=0.05))
+        )
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        stream = plane.wrap_stream(reader, writer, sk, False, b"me")
+        plane.arm()
+        await stream.send(WireMessage("ping", None))  # held
+        plane.disarm()
+        await stream.send(WireMessage("pong", None))  # direct
+        await plane.drain()
+        assert await wait_for(lambda: len(received) == 2)
+        assert plane.log.counts[T.BYZ_LINK_DELAY] == 1
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+# -- the ported contract ------------------------------------------------------
+
+
+class _FakeNode:
+    def __init__(self):
+        self.metrics = MetricsRegistry()
+        self.fault_log = []
+
+
+def test_wire_contract_unobserved_injection_fails():
+    """The tentpole pin: an injected wire fault kind with NO observable
+    trace is a verification failure, exactly like the sim tier."""
+    plane = ChaosPlane(WireChaosSpec())
+    plane.log.note(T.BYZ_SIG_CORRUPT)
+    node = _FakeNode()
+    violations = verify_wire_scenario(plane, [node])
+    assert violations and "sig_corrupt" in violations[0]
+    # a detection counter satisfies it ...
+    node.metrics.counter("wire_sig_rejected").inc()
+    assert verify_wire_scenario(plane, [node]) == []
+    # ... and so does a fault-ring entry alone
+    ring_only = _FakeNode()
+    ring_only.fault_log.append(("ab", WireFault("wire: bad signature")))
+    assert verify_wire_scenario(plane, [ring_only]) == []
+
+
+def test_wire_contract_unregistered_kind_is_violation():
+    plane = ChaosPlane(WireChaosSpec())
+    plane.log.counts["novel_attack"] = 3
+    violations = verify_wire_scenario(plane, [_FakeNode()])
+    assert violations and "novel_attack" in violations[0]
+
+
+def test_wire_contract_crash_kind_accepts_recovery_observables():
+    plane = ChaosPlane(WireChaosSpec())
+    plane.log.note(T.BYZ_CRASH)
+    node = _FakeNode()
+    assert verify_wire_scenario(plane, [node])  # nothing observed: fails
+    node.metrics.counter("node_fast_forwards").inc()
+    assert verify_wire_scenario(plane, [node]) == []
+
+
+# -- bounded wire retry -------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_wire_retry_abandons_after_cumulative_cap():
+    """A frame for a peer that never returns is dropped LOUDLY after
+    WIRE_RETRY_CAP total attempts — fault ring + counter — instead of
+    retrying forever."""
+    node = Hydrabadger(InAddr("127.0.0.1", BASE_PORT + 90), fast_config())
+    uid = Uid()
+    msg = WireMessage("message", (uid.bytes, ("noop",)))
+    node._queue_wire_retry(uid, msg)
+    for _ in range(WIRE_RETRY_CAP + 2):
+        node._wire_retry_tick()
+    assert not node._wire_retry
+    assert node.metrics.counter("wire_retry_abandoned").value == 1
+    assert any(
+        f.kind == "wire: retry abandoned" for _n, f in node.fault_log
+    )
+
+
+@pytest.mark.asyncio
+async def test_wire_retry_attempts_survive_salvage_cycles():
+    """The satellite's actual bug: salvage used to re-park frames with
+    attempts=0, so a flapping peer could cycle one frame forever.  The
+    cumulative ledger remembers across cycles."""
+    node = Hydrabadger(InAddr("127.0.0.1", BASE_PORT + 91), fast_config())
+    uid = Uid()
+    msg = WireMessage("message", (uid.bytes, ("noop",)))
+    for _ in range(WIRE_RETRY_CAP):
+        # each cycle: freshly parked (as salvage would), one retry tick
+        node._queue_wire_retry(uid, msg)
+        node._wire_retry_tick()
+        node._wire_retry.clear()  # simulate the frame leaving the queue
+    # the NEXT salvage re-park hits the exhausted budget immediately
+    node._queue_wire_retry(uid, msg)
+    assert node.metrics.counter("wire_retry_abandoned").value >= 1
+    assert not node._wire_retry
+
+
+# -- certified-frontier fast-forward ------------------------------------------
+
+
+def _validator_node(port: int, n: int = 4):
+    """A Hydrabadger with a real validator DynamicHoneyBadger installed
+    (dealer keys), plus its peer ids — no sockets."""
+    from hydrabadger_tpu.consensus.dynamic_honey_badger import (
+        DynamicHoneyBadger,
+    )
+    from hydrabadger_tpu.consensus.types import NetworkInfo
+    from hydrabadger_tpu.crypto import threshold as th
+
+    node = Hydrabadger(InAddr("127.0.0.1", port), fast_config(), seed=7)
+    rng = random.Random(13)
+    ids = sorted([node.uid.bytes] + [Uid().bytes for _ in range(n - 1)])
+    sks = th.SecretKeySet.random((n - 1) // 3, rng)
+    share = sks.secret_key_share(ids.index(node.uid.bytes))
+    netinfo = NetworkInfo(node.uid.bytes, ids, sks.public_keys(), share)
+    id_sks = {nid: th.SecretKey.random(rng) for nid in ids}
+    id_sks[node.uid.bytes] = node.secret_key
+    pub_keys = {nid: sk.public_key() for nid, sk in id_sks.items()}
+    node.dhb = DynamicHoneyBadger(
+        node.uid.bytes, node.secret_key, netinfo, pub_keys,
+        encrypt=False, coin_mode="hash", verify_shares=False,
+        rng=random.Random(5), session_id=b"net",
+    )
+    node.state = "validator"
+    return node, [nid for nid in ids if nid != node.uid.bytes]
+
+
+def test_fast_forward_requires_f_plus_one_claims():
+    """One lying peer cannot wedge a node at a forged future epoch: a
+    single claim certifies nothing at n=4 (f=1)."""
+    node, peers = _validator_node(BASE_PORT + 92)
+    assert node.dhb.epoch == 0
+    node._ff_claims[peers[0]] = (0, 1000, None)
+    node._maybe_fast_forward()
+    assert node.dhb.epoch == 0  # unmoved
+    assert node.metrics.counter("node_fast_forwards").value == 0
+    # a second distinct validator claim certifies min(1000, 40) = 40
+    node._ff_claims[peers[1]] = (0, 40, None)
+    node._maybe_fast_forward()
+    assert node.dhb.epoch == 40  # the honest-backed frontier, NOT 1000
+    assert node.dhb.is_validator  # share carried over
+    assert node.state == "validator"
+    assert node.metrics.counter("node_fast_forwards").value == 1
+    assert any(
+        f.kind == "wire: fast-forward" for _n, f in node.fault_log
+    )
+
+
+def test_fast_forward_ignores_small_gaps():
+    node, peers = _validator_node(BASE_PORT + 93)
+    node._ff_claims[peers[0]] = (0, 2, None)
+    node._ff_claims[peers[1]] = (0, 2, None)
+    node._maybe_fast_forward()
+    assert node.dhb.epoch == 0  # +2 is pipelining, not wedging
+    assert node.metrics.counter("node_fast_forwards").value == 0
+
+
+def test_frontier_claims_only_from_validators():
+    node, peers = _validator_node(BASE_PORT + 94)
+
+    class P:
+        uid = Uid()  # NOT in the validator set
+
+    node._note_frontier_claim(("active", 0, 99), P())
+    assert node._ff_claims == {}
+
+
+def test_era_ahead_adoption_needs_f_plus_one_matching_payloads():
+    """The certification covers the PLAN PAYLOAD, not just the ordinal:
+    a Byzantine validator riding an honest (era, epoch) with a forged
+    pk_set fingerprint cannot get its payload adopted — and f+1
+    byte-identical fingerprints do certify an era-ahead adoption."""
+    node, peers = _validator_node(BASE_PORT + 96)
+    honest_fp = (1, ("a", "b"), (("a", b"pk"),), b"pkset", b"s")
+    forged_fp = (1, ("a", "b"), (("a", b"pk"),), b"FORGED", b"s")
+    node._ff_claims[peers[0]] = (1, 50, honest_fp)
+    node._ff_claims[peers[1]] = (1, 50, forged_fp)
+    # two claims, but no FINGERPRINT group reaches f+1=2: nothing moves
+    assert node._certified_frontier() is None
+    node._ff_claims[peers[2]] = (1, 60, honest_fp)
+    cert = node._certified_frontier()
+    assert cert == (1, 50, honest_fp)  # (f+1)-th largest WITHIN the group
+
+
+# -- cluster integration ------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_crash_restart_fast_forward_recovery():
+    """The recovery loop end to end on the fast tier: a validator is
+    stopped, the network advances well past its checkpoint, and the
+    restarted node fast-forwards to the certified frontier and commits
+    byte-identical batches again."""
+    cfg = fast_config()
+    nodes = []
+    base = BASE_PORT
+    for i in range(4):
+        node = Hydrabadger(InAddr("127.0.0.1", base + i), cfg, seed=300 + i)
+        nodes.append(node)
+    try:
+        for i, node in enumerate(nodes):
+            await node.start(
+                [OutAddr("127.0.0.1", base + j) for j in range(4) if j != i],
+                gen_txns,
+            )
+        assert await wait_for(lambda: all(n.is_validator() for n in nodes))
+        assert await wait_for(
+            lambda: min(len(n.batches) for n in nodes) >= 2
+        )
+        victim = nodes[1]
+        ckpt = victim.checkpoint()
+        survivors = [n for n in nodes if n is not victim]
+        await victim.crash()
+        # the network advances far past the checkpoint epoch
+        target = max(n.current_epoch for n in survivors) + 5
+        assert await wait_for(
+            lambda: min(n.current_epoch for n in survivors) >= target
+        ), "survivors stalled while victim was down"
+        restarted = Hydrabadger.from_checkpoint(
+            InAddr("127.0.0.1", base + 1), ckpt, cfg, seed=999
+        )
+        nodes[1] = restarted
+        await restarted.start(
+            [OutAddr("127.0.0.1", base + j) for j in range(4) if j != 1],
+            gen_txns,
+        )
+        assert await wait_for(
+            lambda: len(restarted.batches) >= 2, timeout=45
+        ), "recovered node never caught up"
+        # recovery went through a recovery observable (fast-forward at
+        # this gap, or removal + observer re-adoption if votes landed)
+        snap = restarted.metrics.snapshot()["counters"]
+        assert (
+            snap.get("node_fast_forwards", 0)
+            + snap.get("observer_adoptions", 0)
+        ) >= 1
+        # byte-identical agreement on every epoch committed by both
+        sv = survivors[0]
+        by_epoch = {b.epoch: chaos._batch_key(b) for b in sv.batches}
+        overlap = [
+            b for b in restarted.batches if b.epoch in by_epoch
+        ]
+        assert overlap, "no overlapping epochs to compare"
+        for b in overlap:
+            assert chaos._batch_key(b) == by_epoch[b.epoch]
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+@pytest.mark.asyncio
+async def test_chaos_cluster_fast_smoke():
+    """The harness end to end at the fast tier: link faults + a
+    replay-flooding Byzantine peer + crash/restart, contract verified
+    inside the harness itself."""
+    row = await chaos.chaos_cluster(
+        n=4, f_byz=1, epochs=5, base_port=BASE_PORT + 20,
+        encrypt=False, verify_shares=False, coin_mode="hash",
+        wire_sign=False, strategies=("replay_flood",),
+        crash=True, crash_down_s=1.5, deadline_s=180,
+    )
+    assert row["agreement_ok"] and row["contract_ok"]
+    assert row["epochs"] >= 5
+    assert row["byz_injected"].get("replay_flood", 0) > 0
+    assert row["recovery_catchup_s"] is not None
+
+
+@pytest.mark.slow
+@pytest.mark.byz
+@pytest.mark.asyncio
+async def test_chaos_cluster_full_crypto_acceptance():
+    """The acceptance run: full crypto tier, f=1 Byzantine peer
+    (withheld + garbage shares through the pairing verify plane, replay
+    floods), signature corruption, link faults with a partition window,
+    and one crash/restart — every epoch committed in honest-quorum
+    agreement, byte-identical recovery, contract verified."""
+    row = await chaos.chaos_cluster(
+        n=4, f_byz=1, epochs=6, base_port=BASE_PORT + 30,
+        crash=True, deadline_s=500,
+    )
+    assert row["agreement_ok"] and row["contract_ok"]
+    assert row["epochs"] >= 6
+    assert row["byz_injected"].get("sig_corrupt", 0) > 0
+    assert row["detections"]["wire_sig_rejected"] > 0
+    assert row["recovery_catchup_s"] is not None
+
+
+@pytest.mark.asyncio
+async def test_equivocating_peer_detected_over_tcp():
+    """The equivocate strategy over real sockets (no crash: a split
+    RBC coding plus a concurrent crash is 2 faults at n=4): honest
+    nodes flag the mixed echo roots and keep committing."""
+    row = await chaos.chaos_cluster(
+        n=4, f_byz=1, epochs=4, base_port=BASE_PORT + 40,
+        encrypt=False, verify_shares=False, coin_mode="hash",
+        wire_sign=False, strategies=("equivocate",),
+        spec=WireChaosSpec(name="clean"),  # isolate the attack
+        crash=False, deadline_s=180,
+    )
+    assert row["agreement_ok"] and row["contract_ok"]
+    assert row["byz_injected"].get("equivocation", 0) > 0
+    faults = row["byz_faults"]
+    assert faults.get("byz_faults_equivocation", 0) > 0
+
+
+@pytest.mark.asyncio
+async def test_stalled_handshake_culled(monkeypatch):
+    """A connection whose hello/welcome was lost in flight (the chaos
+    plane's signature failure mode) is aborted after the handshake
+    timeout instead of parking verified frames forever."""
+    from hydrabadger_tpu.net import node as node_mod
+
+    monkeypatch.setattr(node_mod, "HANDSHAKE_TIMEOUT_S", 0.3)
+    node = Hydrabadger(InAddr("127.0.0.1", BASE_PORT + 95), fast_config())
+    await node.start([], gen_txns)
+    try:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", BASE_PORT + 95
+        )
+        # never send a hello: the node must cull us, not wait forever
+        assert await wait_for(
+            lambda: node.metrics.counter("handshake_timeouts").value >= 1,
+            timeout=5,
+        )
+        assert await wait_for(lambda: reader.at_eof(), timeout=5)
+        writer.close()
+    finally:
+        await node.stop()

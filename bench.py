@@ -591,6 +591,11 @@ def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
     python_msgs_per_sec = 1.0 / py_per_msg if py_per_msg else 0.0
 
     overlap = _futures.overlap_snapshot()  # one consistent snapshot
+    # round 9: the committed-epoch gap across the era switch — the
+    # headline shadow-DKG gauge (obs/metrics ERA_COMMIT_GAP_S), with
+    # the steady-state denominator and device provenance riding along
+    # so a CPU-only capture can't masquerade as a TPU recapture
+    era_gap = net.era_gap_snapshot()
     return {
         "metric": (
             f"dhb_churn_epochs_per_sec_{n_nodes}node_"
@@ -606,6 +611,12 @@ def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
         "bootstrap_epoch_s": round(bootstrap_epoch_s, 1),
         "era_epoch_s": era_epoch_s,
         "era_switch_s": round(sum(era_epoch_s), 1),
+        "era_commit_gap_s": era_gap["era_commit_gap_s"],
+        "steady_epoch_p50_s": era_gap["steady_epoch_p50_s"],
+        "era_gap_vs_steady": era_gap["era_gap_vs_steady"],
+        "shadow_dkg": era_gap["shadow_dkg"],
+        "shadow_dkg_stall_epochs": era_gap["shadow_dkg_stall_epochs"],
+        "device_overlap_has_device": era_gap["device_overlap_has_device"],
         "total_wall_s": round(_time.perf_counter() - t_total0, 1),
         # hbasync: device overlap through the era switch (obs/metrics
         # DEVICE_OVERLAP_RATIO semantics) with backend provenance —

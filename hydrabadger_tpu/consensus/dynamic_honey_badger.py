@@ -32,7 +32,14 @@ import dataclasses
 from dataclasses import dataclass
 from typing import Dict, Hashable, List, Optional, Tuple, TypeVar
 
-from ..crypto.dkg import Ack, Part, SyncKeyGen
+from ..crypto.dkg import (
+    Ack,
+    Part,
+    SyncKeyGen,
+    shadow_budget as _shadow_budget,
+    shadow_scheduling as _shadow_scheduling,
+    shadow_stall_after as _shadow_stall_after,
+)
 from ..crypto.threshold import PublicKey, PublicKeySet, SecretKey
 from ..obs.recorder import resolve as _resolve_recorder
 from ..utils import codec
@@ -124,6 +131,28 @@ class _KeyGenState:
     # committed keygen messages in commit order — the public transcript a
     # stranded joiner replays to derive its secret share (era_transcript)
     transcript: list = dataclasses.field(default_factory=list)
+    # -- shadow-DKG cutover state (round 9) --------------------------------
+    # committed (proposer, Part) pairs whose row settlement is still owed;
+    # drained by the per-epoch shadow budget.  Plain committed data, so
+    # checkpoints pickle it and a resumed node continues the drain.
+    shadow_queue: list = dataclasses.field(default_factory=list)
+    # the structural era-switch gate crossed: the committed transcript is
+    # SEALED here — later part/ack traffic is ignored (exactly what the
+    # legacy flip-at-ready discarded), so generate() over the sealed
+    # state is deterministic no matter how many epochs the cutover takes
+    sealed: bool = False
+    ready_epoch: Optional[int] = None
+    # distinct current-era validators whose committed ("cutover", era)
+    # marker we have seen; the era flips when > f of them exist
+    cutover_votes: set = dataclasses.field(default_factory=set)
+    cutover_sent: bool = False
+    # pre-generated (pk_set, sk_share) once sealed + fully settled, so
+    # the cutover batch installs the new era in O(1) crypto
+    gen_cache: Optional[tuple] = None
+    # stall detector: fingerprint of the committed DKG state and the
+    # epoch it last advanced
+    progress_sig: Optional[tuple] = None
+    progress_epoch: int = 0
 
 
 class DynamicHoneyBadger:
@@ -533,14 +562,30 @@ class DynamicHoneyBadger:
             winner = self._winning_change()
             if winner is not None:
                 self._start_key_gen(winner)
-        if self.key_gen is not None:
-            if self._keygen_ready():
-                change = ("complete", self.key_gen.change)
-            else:
-                change = ("in_progress", self.key_gen.change)
+        kg_state2 = self.key_gen
         era_switched = False
-        if change is not None and change[0] == "complete":
-            era_switched = True
+        if kg_state2 is not None:
+            if not kg_state2.sealed and self._keygen_ready():
+                # SEAL: the structural gate crossed at THIS committed
+                # batch on every node, so the committed transcript is
+                # frozen here — generate() over the sealed state is the
+                # canonical result no matter how many epochs the
+                # cutover-marker quorum takes to commit, and later
+                # part/ack traffic is ignored exactly as the legacy
+                # flip-at-ready discarded it.
+                kg_state2.sealed = True
+                kg_state2.ready_epoch = self.epoch
+            # budgeted shadow settlement + cutover pre-generation run
+            # every committed batch while a keygen is live (quiet
+            # batches drain the queue too)
+            self._schedule_shadow(step)
+            self._maybe_emit_cutover(step)
+            self._note_keygen_progress(step)
+            if kg_state2.sealed and self._cutover_committed():
+                change = ("complete", kg_state2.change)
+                era_switched = True
+            else:
+                change = ("in_progress", kg_state2.change)
         batch = DhbBatch(
             epoch=self.epoch - 1,
             era=self.era,
@@ -700,6 +745,7 @@ class DynamicHoneyBadger:
                 session=self._kg_session(self.era),
             )
             state = _KeyGenState(tuple(change), new_ids, new_pub_keys, kg)
+            state.progress_epoch = self.epoch
             self.key_gen = state
             if self.is_validator:
                 part = kg.propose()
@@ -711,6 +757,7 @@ class DynamicHoneyBadger:
             self.key_gen = _KeyGenState(
                 tuple(change), new_ids, new_pub_keys, _RemovedTracker(new_ids)
             )
+            self.key_gen.progress_epoch = self.epoch
 
     def _commit_keygen_msg(
         self, proposer, kg, step: Step, parts_buf: Optional[List] = None
@@ -723,6 +770,28 @@ class DynamicHoneyBadger:
             kind = frozen[0]
         except (ValueError, TypeError, IndexError):
             step.fault(proposer, "dhb: malformed keygen message")
+            return
+        if kind == "cutover":
+            # Era-cutover marker (round 9): a current-era validator's
+            # committed claim that its shadow DKG is fully settled.  The
+            # era flips at the first committed batch where the sealed
+            # gate holds AND > f distinct proposers have marked — both
+            # committed data, so every node flips at the same batch.
+            # Markers are schedule data like the "batch" boundary
+            # markers: never transcripted (a replaying joiner derives
+            # its share from parts/acks alone), and a stale-era marker
+            # is ignored rather than counted.
+            try:
+                if int(frozen[1]) == self.era:
+                    state.cutover_votes.add(proposer)
+            except (ValueError, TypeError, IndexError):
+                step.fault(proposer, "dhb: malformed keygen message")
+            return
+        if state.sealed:
+            # the transcript sealed when the structural gate crossed:
+            # later-committed part/ack traffic can no longer change this
+            # era switch's outcome (the legacy flip-at-ready discarded
+            # it identically) — ignore, never fault honest retransmits
             return
         if kind in ("part", "ack"):
             # Only replayable protocol messages enter the committed
@@ -777,9 +846,17 @@ class DynamicHoneyBadger:
             )
 
     def _flush_keygen_parts(self, parts_buf: List, step: Step) -> None:
-        """Flush all parts deferred from one committed batch: every
-        row/commitment RLC check runs as one batched MSM and the ack
-        values seal through the batched channel plane
+        """Intake all parts deferred from one committed batch.
+
+        Shadow mode (round 9, the default — ``HYDRABADGER_SHADOW_DKG=0``
+        reverts): only the STRUCTURAL half runs here on the commit path
+        (``record_parts``: the objective proposal set, a few decodes
+        per part); the row crypto is pushed onto the era's shadow queue
+        and drained by :meth:`_schedule_shadow` at a bounded per-epoch
+        budget, so a DKG part storm never walls a committed batch.
+
+        Legacy mode: every row/commitment RLC check runs as one batched
+        MSM and the ack values seal through the batched channel plane
         (SyncKeyGen.handle_parts) — n host Pippengers and n^2 per-value
         seal calls collapse into one call each per batch.
 
@@ -801,6 +878,21 @@ class DynamicHoneyBadger:
         from ..crypto import futures as _futures
 
         kg = state.key_gen
+        if _shadow_scheduling() and hasattr(kg, "record_parts"):
+            try:
+                outcomes, deferred = kg.record_parts(list(parts_buf))
+            except (ValueError, TypeError, KeyError):
+                # defensive only — see the sync branch's rationale
+                for proposer, _part in parts_buf:
+                    step.fault(proposer, "dhb: keygen part batch failed")
+                return
+            for (proposer, _part), outcome in zip(parts_buf, outcomes):
+                if outcome is not None:
+                    self._apply_part_outcome(proposer, outcome, step)
+            state.shadow_queue.extend(
+                (sid, part) for _i, sid, part in deferred
+            )
+            return
         if _futures.enabled() and hasattr(kg, "handle_parts_submit"):
             try:
                 settle = kg.handle_parts_submit(list(parts_buf))
@@ -864,6 +956,127 @@ class DynamicHoneyBadger:
         if step is None and local.fault_log:
             self._deferred_faults.extend(local.fault_log)
 
+    # -- shadow-DKG scheduling + atomic cutover (round 9) --------------------
+
+    def _schedule_shadow(self, step: Step) -> None:
+        """Drain up to one budget's worth of owed row settlements — the
+        per-epoch shadow slot.  Runs at every committed batch while a
+        keygen is live (quiet batches drain too), double-buffered
+        through ``_kg_inflight`` exactly like the legacy flush, so the
+        settlement MSM overlaps host work when the futures plane is on
+        and the DKG's crypto fills the device's idle shadow instead of
+        blocking the commit path."""
+        state = self.key_gen
+        if state is None or not state.shadow_queue:
+            return
+        from ..crypto import futures as _futures
+
+        kg = state.key_gen
+        budget = _shadow_budget()
+        chunk = state.shadow_queue[:budget]
+        del state.shadow_queue[:budget]
+        try:
+            settle = kg.settle_parts_submit(list(chunk))
+        except (ValueError, TypeError, KeyError):
+            for proposer, _part in chunk:
+                step.fault(proposer, "dhb: keygen part batch failed")
+            return
+        if _futures.enabled():
+            prev, self._kg_inflight = self._kg_inflight, (list(chunk), settle)
+            if prev is not None:
+                self._settle_flush(prev, step)
+        else:
+            self._settle_flush((list(chunk), settle), step)
+
+    def _maybe_emit_cutover(self, step: Step) -> None:
+        """Once SEALED and fully settled: pre-generate the next era's
+        keys in the current era's shadow and (validators) commit the
+        cutover marker.  The marker is the atomic-cutover signal — the
+        era flips only at the committed batch where > f distinct
+        validators have marked, so at least one honest node had
+        finished its settlement before the network cut over, and the
+        flip batch itself installs cached keys in O(1) crypto."""
+        state = self.key_gen
+        if (
+            state is None
+            or not state.sealed
+            or state.cutover_sent
+            or state.shadow_queue
+        ):
+            return
+        # the final settlement chunk may still be in flight: settle it
+        # now — the marker asserts "fully settled", and with the queue
+        # empty there is no next submit for it to overlap
+        self._settle_keygen_inflight(step)
+        kg = state.key_gen
+        if state.gen_cache is None and not isinstance(kg, _RemovedTracker):
+            # generate() over the SEALED state — deterministic, equal to
+            # what the flip batch would compute — so the cutover batch
+            # tears down the old era without a key-derivation wall.
+            # A failure here is deterministic too: leave the cache empty
+            # and let _switch_era's observer-degrade path own it.
+            try:
+                state.gen_cache = kg.generate()
+            except (ValueError, TypeError, KeyError, IndexError):
+                state.gen_cache = None
+        state.cutover_sent = True
+        if self.is_validator:
+            self.pending_kg.append(("cutover", self.era))
+
+    def _cutover_committed(self) -> bool:
+        """Flip gate: > f distinct committed cutover markers (current-era
+        proposers), evaluated on committed data only — with at most f
+        Byzantine validators, at least one marker came from an honest
+        node that truly finished its shadow settlement."""
+        state = self.key_gen
+        f = (self.netinfo.num_nodes - 1) // 3
+        return len(state.cutover_votes) > f
+
+    def _note_keygen_progress(self, step: Step) -> None:
+        """Stall detector: the shadow DKG must degrade LOUDLY, never
+        wedge.  If the committed DKG state (proposals, structural acks,
+        cutover markers) stops advancing — withheld Parts, a starved
+        marker quorum — the current era keeps committing (nothing here
+        blocks the batch path) and a periodic fault + the
+        ``shadow_dkg_stall_epochs`` gauge (mirrored by the sim/net
+        harnesses) make the stall observable; silent tolerance fails
+        scenario runs via FAULT_OBSERVABLES."""
+        state = self.key_gen
+        kg = state.key_gen
+        if hasattr(kg, "parts"):
+            acks = sum(len(s.acks) for s in kg.parts.values())
+            sig = (len(kg.parts), acks, len(state.cutover_votes), state.sealed)
+        else:  # _RemovedTracker
+            acks = sum(len(a) for a in kg.ack_counts.values())
+            sig = (
+                len(kg.commitments), acks,
+                len(state.cutover_votes), state.sealed,
+            )
+        if sig != state.progress_sig:
+            state.progress_sig = sig
+            state.progress_epoch = self.epoch
+            return
+        stalled = self.epoch - state.progress_epoch
+        limit = _shadow_stall_after()
+        if stalled > 0 and stalled % limit == 0:
+            step.fault(
+                self.our_id,
+                f"dhb: shadow keygen stalled ({stalled} epochs without "
+                "DKG progress; current era keeps committing)",
+            )
+            getattr(self, "obs", _resolve_recorder(None)).instant(
+                "shadow_dkg_stall", era=self.era, epochs=stalled,
+            )
+
+    def shadow_stall_epochs(self) -> int:
+        """Epochs since the live shadow DKG last advanced (0 = healthy
+        or no keygen) — the number behind the harness-owned
+        ``shadow_dkg_stall_epochs`` gauge."""
+        state = self.key_gen
+        if state is None:
+            return 0
+        return max(0, self.epoch - getattr(state, "progress_epoch", self.epoch))
+
     def drain_async(self) -> Step:
         """Settle any in-flight device work and return its step — the
         tick-boundary drain the sim calls after each router run (and
@@ -883,11 +1096,22 @@ class DynamicHoneyBadger:
         # land (and are cleared) exactly as on the synchronous path
         self._settle_keygen_inflight(step)
         state = self.key_gen
+        # Settlement still owed when the cutover committed (f+1 faster
+        # peers marked first): the owed work is our OUTGOING acks and
+        # per-proposer fault attribution for the OLD era — both moot
+        # once the era flips (pending_kg clears below; our share derives
+        # from the sealed ack VALUES, never from our row settlements).
+        # Discard rather than paying a settlement wall at the flip batch.
+        state.shadow_queue = []
         new_era = self.epoch
         kg_era = self.era  # the era this keygen's channel nonces used
         try:
             if isinstance(state.key_gen, _RemovedTracker):
                 pk_set, sk_share = state.key_gen.generate(), None
+            elif state.gen_cache is not None:
+                # pre-generated in the shadow at cutover-marker time —
+                # identical to generate() here (the state is sealed)
+                pk_set, sk_share = state.gen_cache
             else:
                 pk_set, sk_share = state.key_gen.generate()
         except ValueError:

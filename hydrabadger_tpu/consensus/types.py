@@ -75,6 +75,7 @@ BYZ_GARBAGE_SHARE = "garbage_share"  # attacker-chosen G1 point as a tdec share
 BYZ_WITHHELD_SHARE = "withheld_share"  # own decryption share never sent
 BYZ_DKG_CORRUPT = "dkg_corrupt"  # malformed Part/Ack in committed contributions
 BYZ_REPLAY_FLOOD = "replay_flood"  # other senders' frames replayed as our own
+BYZ_KEYGEN_WITHHOLD = "keygen_withhold"  # own DKG Parts/Acks never shipped
 BYZ_LINK_DROP = "link_drop"  # per-link loss (breaks the reliable-delivery model)
 BYZ_LINK_DUP = "link_dup"  # per-link duplication
 BYZ_LINK_DELAY = "link_delay"  # per-link hold/reorder
@@ -92,6 +93,7 @@ BYZ_KINDS = frozenset(
         BYZ_WITHHELD_SHARE,
         BYZ_DKG_CORRUPT,
         BYZ_REPLAY_FLOOD,
+        BYZ_KEYGEN_WITHHOLD,
         BYZ_LINK_DROP,
         BYZ_LINK_DUP,
         BYZ_LINK_DELAY,

@@ -274,6 +274,56 @@ def fr_interpolate_at_zero(points) -> int:
     return poly_interpolate_at_zero(points)
 
 
+# ---------------------------------------------------------------------------
+# Shadow-DKG scheduling gates (round 9).  These live HERE, not in the
+# consensus core, because env reads are I/O and the consensus tier is
+# sans-io by contract (hblint) — dhb imports the resolved policy.
+# ---------------------------------------------------------------------------
+
+
+def shadow_scheduling() -> bool:
+    """Is the round-9 shadow-DKG scheduling plane on?  The
+    ``HYDRABADGER_SHADOW_DKG`` kill-switch (lint-registered) gates only
+    WHERE the next era's row crypto runs — the budgeted per-epoch
+    shadow drain (default) vs inline at the committing batch (legacy,
+    ``=0``).  The cutover-marker protocol itself is unconditional: it
+    is committed protocol state, and mixing flip rules across nodes
+    would fork the era switch."""
+    import os
+
+    return os.environ.get("HYDRABADGER_SHADOW_DKG", "1") != "0"
+
+
+def shadow_budget() -> int:
+    """Committed parts whose row settlement runs per committed batch —
+    the bound that keeps DKG crypto from walling any single epoch.
+    ``HYDRABADGER_SHADOW_DKG_BUDGET`` tunes it; the value must match
+    across nodes only for bit-identical local schedules (the
+    point-identity pins), never for safety — the era-switch gates count
+    committed data only."""
+    import os
+
+    try:
+        return max(
+            1, int(os.environ.get("HYDRABADGER_SHADOW_DKG_BUDGET", "16"))
+        )
+    except ValueError:
+        return 16
+
+
+def shadow_stall_after() -> int:
+    """Epochs without committed DKG progress before the shadow-DKG
+    stall turns loud (``HYDRABADGER_SHADOW_STALL_EPOCHS``)."""
+    import os
+
+    try:
+        return max(
+            1, int(os.environ.get("HYDRABADGER_SHADOW_STALL_EPOCHS", "8"))
+        )
+    except ValueError:
+        return 8
+
+
 def _keystream_xor(key: bytes, ctx: bytes, data: bytes) -> bytes:
     """XOR with the SHA-256 counter keystream (one int-wide XOR — the
     byte-wise generator was measurable at era-switch volume)."""
@@ -629,6 +679,10 @@ class _ProposalState:
     our_column: Optional[List[tuple]] = None
     # round 3: ack values verify lazily in batch (SyncKeyGen._verify_values)
     values_verified: bool = True
+    # round 9 (shadow DKG): recorded structurally, own-row settlement
+    # (decrypt + RLC verify + ack generation) still owed to
+    # SyncKeyGen.settle_parts_submit
+    row_pending: bool = False
 
     def is_complete(self, threshold: int) -> bool:
         """OBJECTIVE completion: counts structurally-valid acks, which are
@@ -859,10 +913,51 @@ class SyncKeyGen(Generic[N]):
         bit-identical to the synchronous path in every recorded state
         and emitted ack.  Callers may hold the closure across further
         host work (the dhb double-buffer) but MUST invoke it before
-        the outcomes' effects are due."""
+        the outcomes' effects are due.
+
+        Shadow split (round 9): the structural phase and the row-crypto
+        settlement are independently callable — :meth:`record_parts` /
+        :meth:`settle_parts_submit` — so the dhb shadow-DKG scheduler
+        can commit the structural state inline (the objective proposal
+        set the era-switch gate counts) and spread the settlement
+        across the steady-state epoch cadence.  This method composes
+        the two: record everything, settle everything."""
+        outcomes, deferred = self.record_parts(items)
+        if not deferred:
+            return lambda: outcomes  # type: ignore[return-value]
+        settle_rows = self.settle_parts_submit(
+            [(sid, part) for _i, sid, part in deferred]
+        )
+
+        def settle() -> List[PartOutcome]:
+            for (i, _sid, _part), oc in zip(deferred, settle_rows()):
+                outcomes[i] = oc
+            return outcomes  # type: ignore[return-value]
+
+        return settle
+
+    def record_parts(self, items: List[Tuple[N, Part]]):
+        """STRUCTURAL intake of a batch of proposals — the commit-path
+        half of the round-9 shadow split.
+
+        Runs only the checks that depend on the committed bytes alone
+        (member sender, duplicate/conflict, decodable commitment,
+        degree, row count) and records accepted proposals with their
+        row crypto still OWED: the proposal set — and with it the
+        objective era-switch gate — settles at commit time for a few
+        decode-and-compare operations per part, while the expensive
+        settlement (row decryption, the RLC/commitment MSM, ack-value
+        evaluation + sealing) is deferred to
+        :meth:`settle_parts_submit`, schedulable by the caller across
+        later epochs.
+
+        Returns ``(outcomes, deferred)``: ``outcomes[i]`` is a terminal
+        :class:`PartOutcome` (structural reject, or duplicate — whose
+        ack the ORIGINAL entry's settlement owns) or ``None`` for a
+        recorded proposal, and ``deferred`` lists ``(i, sender_id,
+        part)`` for every ``None`` slot."""
         outcomes: List[Optional[PartOutcome]] = [None] * len(items)
-        pending = []  # (slot, proposer idx, state, row, raw, part)
-        mode = _tpu_dkg_mode(self.threshold)
+        deferred: List[tuple] = []
         for i, (sender_id, part) in enumerate(items):
             try:
                 s = self.node_index(sender_id)
@@ -877,7 +972,8 @@ class SyncKeyGen(Generic[N]):
                     outcomes[i] = PartOutcome(
                         False, fault="conflicting part"
                     )
-                else:  # duplicate; ack already sent (or pending below)
+                else:  # duplicate; ack already sent (or owed by the
+                    # original entry's pending settlement)
                     outcomes[i] = PartOutcome(True)
                 continue
             try:
@@ -893,6 +989,41 @@ class SyncKeyGen(Generic[N]):
             if len(part.enc_rows) != len(self.node_ids):
                 outcomes[i] = PartOutcome(False, fault="wrong row count")
                 continue
+            self.parts[s] = _ProposalState(
+                commit, row=None, row_pending=True
+            )
+            deferred.append((i, sender_id, part))
+        return outcomes, deferred
+
+    def settle_parts_submit(self, items: List[Tuple[N, Part]]):
+        """Row-crypto settlement for proposals ALREADY recorded by
+        :meth:`record_parts`: decrypt our row, verify every pending row
+        against its commitment (all RLC right-hand sides as ONE batched
+        MSM), and evaluate + seal the outgoing ack values — everything
+        the legacy inline path ran after the structural checks.
+
+        ``items`` is ``[(sender_id, part)]`` of plain committed data,
+        so a caller may hold entries across epochs — and checkpoints:
+        the dhb shadow queue pickles them and resumes the drain.
+        Returns a zero-arg settle closure -> ``[PartOutcome]`` aligned
+        with ``items``; an entry whose settlement already ran (a
+        duplicate queued twice) yields a benign ``PartOutcome(True)``."""
+        outcomes: List[Optional[PartOutcome]] = [None] * len(items)
+        pending = []  # (slot, proposer idx, state, row, raw, part)
+        mode = _tpu_dkg_mode(self.threshold)
+        for i, (sender_id, part) in enumerate(items):
+            try:
+                s = self.node_index(sender_id)
+            except ValueError:
+                outcomes[i] = PartOutcome(
+                    False, fault="part from non-member"
+                )
+                continue
+            state = self.parts.get(s)
+            if state is None or not getattr(state, "row_pending", False):
+                outcomes[i] = PartOutcome(True)  # settled already
+                continue
+            state.row_pending = False
             if mode == "forced":
                 # one batched device fold of ALL nodes' COLUMN
                 # commitments, cached on the shared decoded commitment —
@@ -903,7 +1034,9 @@ class SyncKeyGen(Generic[N]):
                 # commitment is SHARED by every in-process node (the
                 # sim/bench plane).
                 try:
-                    commit.warm_folds(range(1, len(self.node_ids) + 1))
+                    state.commitment.warm_folds(
+                        range(1, len(self.node_ids) + 1)
+                    )
                 except Exception:  # pragma: no cover - native fallback
                     pass
             elif mode == "auto":
@@ -911,7 +1044,7 @@ class SyncKeyGen(Generic[N]):
                 # warming all n is n× wasted synchronous device work on
                 # the key-gen message path (ADVICE r5)
                 try:
-                    commit.warm_folds([self.our_idx + 1])
+                    state.commitment.warm_folds([self.our_idx + 1])
                 except Exception:  # pragma: no cover - native fallback
                     pass
             row: Optional[List[int]] = None
@@ -930,11 +1063,10 @@ class SyncKeyGen(Generic[N]):
                     fault = "undecryptable row"
             if row is not None and len(row) != self.threshold + 1:
                 row, fault = None, "wrong row degree"
-            state = _ProposalState(commit, row=row)
-            self.parts[s] = state
             if row is None:
                 outcomes[i] = PartOutcome(False, fault=fault, recorded=True)
                 continue
+            state.row = row
             pending.append((i, s, state, row, raw, part))
         if not pending:
             return lambda: outcomes  # type: ignore[return-value]

@@ -142,9 +142,9 @@ def _suppressions(sf: SourceFile) -> Tuple[Dict[int, Dict[str, str]], List[Findi
 
 def all_rules():
     """The rule registry, in report order."""
-    from . import async_fetch, deadcode, jit_hygiene, limb_layout
-    from . import mosaic, retrace_budget, sansio, secrets, taint
-    from . import wire_contract
+    from . import async_fetch, deadcode, env_flags, jit_hygiene
+    from . import limb_layout, mosaic, retrace_budget, sansio, secrets
+    from . import taint, wire_contract
 
     return [
         sansio,
@@ -153,6 +153,7 @@ def all_rules():
         limb_layout,
         wire_contract,
         async_fetch,
+        env_flags,
         taint,
         secrets,
         retrace_budget,

@@ -312,3 +312,79 @@ CONFIG_BOUNDED_JIT = {
         "same [2^m, tail] geometry as _afft_fwd_T"
     ),
 }
+
+# --------------------------------------------------------------------------
+# environment flags (lint/env_flags.py)
+# --------------------------------------------------------------------------
+
+# Every HYDRABADGER_* environment variable the package reads, with a
+# one-line owner description — the kill-switch/threshold inventory.  An
+# unregistered read is a finding (rule ``env-flag``): a flag that
+# appears in no inventory is exactly how a plane-disabling switch rots.
+# tests/test_lint.py additionally verifies each entry is LIVE (some
+# package source still reads it), so stale entries can't accumulate.
+ENV_FLAGS = {
+    "HYDRABADGER_TPU_DKG": (
+        "era-switch DKG crypto on the accelerator: 1 forced, 0 off, "
+        "unset = auto when a TPU backend is already live (crypto/dkg)"
+    ),
+    "HYDRABADGER_ASYNC": (
+        "hbasync cross-poll deferral; 0 settles every future at its "
+        "submission site (crypto/futures)"
+    ),
+    "HYDRABADGER_COALESCE": (
+        "per-tick MSM coalescing across in-process nodes; the sim "
+        "scopes it on (crypto/futures.MsmCoalescer)"
+    ),
+    "HYDRABADGER_SHADOW_DKG": (
+        "round-9 kill-switch: 0 reverts shadow-DKG scheduling to the "
+        "inline-at-commit legacy path; the cutover-marker protocol "
+        "itself is unconditional (consensus/dynamic_honey_badger)"
+    ),
+    "HYDRABADGER_SHADOW_DKG_BUDGET": (
+        "committed parts settled per epoch by the shadow drain "
+        "(default 16; consensus/dynamic_honey_badger)"
+    ),
+    "HYDRABADGER_SHADOW_STALL_EPOCHS": (
+        "epochs without committed DKG progress before the stall fault "
+        "fires (default 8; consensus/dynamic_honey_badger)"
+    ),
+    "HYDRABADGER_NTT": (
+        "0 pins the reference polynomial paths everywhere (NTT plane "
+        "kill-switch; crypto/dkg, crypto/rs)"
+    ),
+    "HYDRABADGER_NTT_MIN_N": (
+        "Fr multipoint/NTT routing floor, default 384 (crypto/dkg)"
+    ),
+    "HYDRABADGER_NTT_MIN_SHARDS": (
+        "RS FFT routing floor, default 128 without native SIMD "
+        "(crypto/rs, crypto/engine)"
+    ),
+    "HYDRABADGER_FOLD_CACHE": (
+        "vandermonde fold-fn cache size, default 32 (ops/vandermonde_T)"
+    ),
+    "HYDRABADGER_CKPT_KEY": (
+        "checkpoint HMAC authentication key (checkpoint.py)"
+    ),
+    "HYDRABADGER_LOG": "structured logging level/filter spec (obs/logging)",
+    "HYDRABADGER_NO_NATIVE_BLS": (
+        "1 disables the native BLS library (crypto/native_bls)"
+    ),
+    "HYDRABADGER_NO_NATIVE_ACS": (
+        "set to disable the native C++ ACS engine (sim/native_acs)"
+    ),
+    "HYDRABADGER_TPU_NATIVE_LIB": (
+        "explicit path to the native acceleration library (crypto/_native)"
+    ),
+    "HYDRABADGER_TPU_BLS_LIB": (
+        "explicit path to the native BLS library (crypto/native_bls)"
+    ),
+    "HYDRABADGER_FQ_CARRY": "Fq limb carry-strategy override (ops/bls_jax)",
+    "HYDRABADGER_FQ_PATH": "Fq mul path override (ops/bls_jax)",
+    "HYDRABADGER_WIN_CIRCUIT": (
+        "0 disables the windowed decrypt circuit (ops/decrypt_T)"
+    ),
+    "HYDRABADGER_DECRYPT_T": (
+        "tensor-sim decrypt plane override (sim/tensor)"
+    ),
+}

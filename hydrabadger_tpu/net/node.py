@@ -71,6 +71,16 @@ HANDSHAKE_TIMEOUT_S = 5.0
 # re-broadcasts its current-epoch consensus frames (bounded ring)
 EPOCH_OUTBOX_MAX = 8192
 EPOCH_REPLAY_TICK_S = 1.0
+# Hard (jittered) ceiling on the backed-off INTER-REPLAY spacing (up
+# to 16x the stall threshold without it).  The PR-8 config-12 capture
+# hit an 80 s worst-gap stall from exactly this compounding: chaos
+# resets re-parked frames while an EMA inflated by the fault window
+# times the 16x backoff pushed the next replay minutes out — precisely
+# when replay was the only healer.  The stall THRESHOLD itself stays
+# EMA-honest and uncapped (a 60 s full-crypto epoch is not a stall at
+# 20 s); the +-20% jitter desynchronizes a cluster whose nodes all
+# wedged at the same instant.
+REPLAY_GAP_CEILING_S = 20.0
 # connection keepalive (reference ping/pong, lib.rs WireMessageKind):
 # a quiet link and a dead link are indistinguishable to TCP for
 # minutes; a periodic ping keeps NAT/conntrack state warm and turns a
@@ -811,6 +821,46 @@ class Hydrabadger:
 
     # -- handshake / discovery ----------------------------------------------
 
+    @staticmethod
+    def _frontier_doc(era, epoch, roster, validator_pks, pk_set_b,
+                      session) -> bytes:
+        """The signed document of one frontier claim: exactly the plan
+        fingerprint _certified_frontier groups by (era, roster, the
+        VALIDATORS' identity keys, pk_set, session) plus the claimed
+        epoch — everything an adoption would trust."""
+        from ..utils import codec
+
+        return b"HBTPU-FRONTIER" + codec.encode(
+            (
+                int(era),
+                int(epoch),
+                tuple(roster),
+                tuple(validator_pks),
+                bytes(pk_set_b),
+                bytes(session),
+            )
+        )
+
+    def _frontier_sig(self, plan) -> bytes:
+        """Our identity-key signature over the current frontier claim,
+        cached per (era, epoch) — _net_state is rebuilt on every
+        welcome/gossip reply, and one BLS sign per epoch is plenty."""
+        cached = getattr(self, "_frontier_sig_cache", None)
+        if cached is not None and cached[0] == (plan.era, plan.epoch):
+            return cached[1]
+        roster = tuple(plan.node_ids)
+        doc = self._frontier_doc(
+            plan.era,
+            plan.epoch,
+            roster,
+            tuple((n, plan.pub_keys[n]) for n in roster),
+            plan.pk_set_bytes,
+            plan.session_id,
+        )
+        sig = self.secret_key.sign(doc).to_bytes()
+        self._frontier_sig_cache = ((plan.era, plan.epoch), sig)
+        return sig
+
     def _net_state(self) -> tuple:
         peers_info = tuple(
             (p.uid.bytes, p.in_addr.host, p.in_addr.port, p.pk.to_bytes())
@@ -828,6 +878,12 @@ class Hydrabadger:
                 plan.pk_set_bytes,
                 plan.session_id,
                 peers_info,
+                # validator signature over the frontier claim (round 9,
+                # PR-8's named headroom): net_state gossip itself is
+                # relayable/attacker-writable, so _certified_frontier
+                # counts only claims that verify under the COMMITTED
+                # identity key of the claimed validator
+                self._frontier_sig(plan),
             )
         if self.state == "generating_keys":
             return ("generating_keys", peers_info)
@@ -1010,7 +1066,8 @@ class Hydrabadger:
                 # — but keep dialling the peers the gossip just taught us
                 self._discover(net_state[7])
                 return
-            (_tag, era, epoch, node_ids, pub_keys, pk_set_b, session, peers_info) = net_state
+            (_tag, era, epoch, node_ids, pub_keys, pk_set_b, session,
+             peers_info, _sig) = net_state
             plan = JoinPlan(
                 era=int(era),
                 epoch=int(epoch),
@@ -1031,11 +1088,14 @@ class Hydrabadger:
 
     def _note_frontier_claim(self, net_state, peer: Optional[Peer]) -> None:
         """Record an established validator's claimed (era, epoch)
-        frontier.  net_state is UNSIGNED (attacker-writable), so no
-        single claim moves us: a fast-forward requires f+1 distinct
-        validator claimants at/above the target epoch — at least one of
-        them honest — or one lying peer could wedge a healthy node at a
-        forged future epoch forever."""
+        frontier.  Two independent defenses (a frontier hijack moves a
+        node's whole consensus view): the claim must carry a signature
+        verifying under the COMMITTED identity key registered for the
+        claiming validator — a connection that merely hello'd as a
+        validator uid cannot mint claims (round 9, PR-8's named
+        headroom) — and even then no single claim moves us: a
+        fast-forward requires f+1 distinct authenticated claimants at/
+        above the target epoch, at least one of them honest."""
         if peer is None or peer.uid is None or self.dhb is None:
             return
         if peer.uid.bytes not in self.dhb.netinfo.node_ids:
@@ -1050,18 +1110,36 @@ class Hydrabadger:
             # (observer pub_keys entries legitimately differ between
             # honest peers and are deliberately excluded).
             (_tag, era, epoch, node_ids, pub_keys, pk_set_b, session,
-             _peers_info) = net_state
+             _peers_info, sig_b) = net_state
             era, epoch = int(era), int(epoch)
             roster = tuple(bytes(n) for n in node_ids)
             pks = {bytes(k): bytes(v) for k, v in pub_keys.items()}
+            validator_pks = tuple((n, pks[n]) for n in roster)
             fingerprint = (
                 era,
                 roster,
-                tuple((n, pks[n]) for n in roster),
+                validator_pks,
                 bytes(pk_set_b),
                 bytes(session),
             )
+            sig = Signature.from_bytes(bytes(sig_b))
         except (TypeError, ValueError, IndexError, KeyError):
+            return
+        # authenticate against the pk COMMITTED for this validator in
+        # our era's pub_keys (identity keys are long-lived, so a
+        # later-era claimant still verifies) — never the hello-presented
+        # key, which any connection chooses freely
+        pk = self.dhb.pub_keys.get(peer.uid.bytes)
+        doc = self._frontier_doc(
+            era, epoch, roster, validator_pks, pk_set_b, session
+        )
+        if pk is None or not pk.verify(sig, doc):
+            self._note_fault(
+                "wire: frontier claim rejected", "wire_frontier_rejected"
+            )
+            log.warning(
+                "unauthenticated frontier claim from %s", peer.out_addr
+            )
             return
         self._ff_claims[peer.uid.bytes] = (era, epoch, fingerprint)
         self._maybe_fast_forward()
@@ -1685,7 +1763,21 @@ class Hydrabadger:
         while self._epoch_outbox and self._epoch_outbox[0][0] < batch.epoch:
             self._epoch_outbox.popleft()
         now = _time.monotonic()
-        dt = min(now - self._last_progress_t, 60.0)
+        raw_dt = now - self._last_progress_t
+        dt = min(raw_dt, 60.0)
+        # round 9: committed-epoch gap across the era-switch window (a
+        # live shadow keygen or the flip itself) — the TCP mirror of the
+        # sim's era_commit_gap_s gauge — plus the loud-stall mirror.
+        # Rows surfacing these must carry device provenance (see
+        # obs/metrics.py).
+        kg_live = getattr(self.dhb, "key_gen", None) is not None
+        prev_era = getattr(self, "_last_batch_era", None)
+        if kg_live or (prev_era is not None and batch.era != prev_era):
+            self.metrics.gauge("era_commit_gap_s").track(round(raw_dt, 3))
+        self._last_batch_era = batch.era
+        stall_fn = getattr(self.dhb, "shadow_stall_epochs", None)
+        if stall_fn is not None:
+            self.metrics.gauge("shadow_dkg_stall_epochs").track(stall_fn())
         # Clamp so a single slow epoch cannot push the stall threshold
         # beyond ~minutes.  Replayed intervals fold at REDUCED weight
         # instead of being skipped (ADVICE r5): with a full skip, a
@@ -2072,13 +2164,31 @@ class Hydrabadger:
         stall age; suppressed ticks are counted so a flood held back by
         the gate is still observable (``epoch_replays_suppressed``).
 
+        Capped (round 9): once a stall is declared, the backed-off
+        inter-replay spacing clamps to a jittered REPLAY_GAP_CEILING_S,
+        so compounded resets + backoff can never hold consecutive
+        replays minutes apart (the config-12 80 s worst-gap stall).
+        The stall THRESHOLD itself stays EMA-honest and uncapped —
+        see the inline note.  Worst-case inter-replay gap is 1.2x the
+        ceiling, pinned by tests/test_net.py.
+
         Returns True — and advances the backoff state — when a replay
         should fire now."""
         ema = self._epoch_ema_s or EPOCH_REPLAY_TICK_S
+        # The stall threshold stays EMA-honest and UNCAPPED: it answers
+        # "is this epoch stalled at all", and a 60 s full-crypto epoch
+        # genuinely is not stalled at 20 s — capping it here would
+        # re-create the r4 misfire (replays flooding every healthy long
+        # epoch).  Only the INTER-REPLAY spacing clamps to the jittered
+        # ceiling: once a stall is declared, compounded backoff can
+        # never hold consecutive replays more than ~1.2x the ceiling
+        # apart (the config-12 80 s gap).
         threshold = max(3.0 * ema, 2.0 * EPOCH_REPLAY_TICK_S)
         if now - self._last_progress_t < threshold:
             return False
-        if now - self._last_replay_t < threshold * self._replay_backoff:
+        ceiling = REPLAY_GAP_CEILING_S * (0.8 + 0.4 * self.rng.random())
+        spacing = min(threshold * self._replay_backoff, ceiling)
+        if now - self._last_replay_t < spacing:
             self.metrics.counter("epoch_replays_suppressed").inc()
             return False
         self._replay_backoff = min(self._replay_backoff * 2.0, 16.0)

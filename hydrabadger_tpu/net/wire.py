@@ -34,8 +34,13 @@ from ..utils.ids import Uid
 MAX_FRAME = 64 * 1024 * 1024
 
 # kinds whose payload must be signature-verified (reference verifies
-# Message/KeyGen, lib.rs:406-416)
-VERIFIED_KINDS = frozenset({"message", "key_gen"})
+# Message/KeyGen, lib.rs:406-416; net_state and join_plan joined the
+# set in round 9 — discovery gossip and join plans steer a node's view
+# of the network, so when frame signing is on their frames must verify
+# like consensus traffic.  The frontier claim INSIDE a net_state
+# additionally carries its own validator signature, checked against the
+# committed identity key regardless of the frame tier.)
+VERIFIED_KINDS = frozenset({"message", "key_gen", "net_state", "join_plan"})
 
 KINDS = frozenset(
     {

@@ -39,6 +39,27 @@ DEVICE_OVERLAP_RATIO = "device_overlap_ratio"
 DEVICE_IDLE_S = "device_idle_s"
 DEVICE_OVERLAP_HAS_DEVICE = "device_overlap_has_device"
 
+# Shadow-DKG era-cutover gauges (round 9).  Names fixed here so the sim
+# drain, the TCP node's batch path, bench config-5 and the era SOAK tier
+# all bind to one spelling:
+#
+#   ERA_COMMIT_GAP_S — high-water wall-clock gap between consecutive
+#       committed batches across an era-switch window (keygen live or
+#       era flipped).  THE headline robustness gauge of the shadow-DKG
+#       plane: the target is <= 2x the steady-state epoch time, vs the
+#       ~180 s-class stop-the-world wall of the pre-shadow era switch.
+#       Rows surfacing it must carry device_backend /
+#       device_overlap_has_device provenance alongside — a CPU-only
+#       capture must not masquerade as a TPU recapture.
+#   SHADOW_DKG_STALL_EPOCHS — epochs since the live shadow DKG last
+#       advanced (harness-mirrored from dhb.shadow_stall_epochs()).  The
+#       loud-stall contract: withheld Parts stall the NEXT era while the
+#       current one keeps committing, and this gauge (plus the periodic
+#       "dhb: shadow keygen stalled" fault) is the declared observable —
+#       silent tolerance fails scenario runs.
+ERA_COMMIT_GAP_S = "era_commit_gap_s"
+SHADOW_DKG_STALL_EPOCHS = "shadow_dkg_stall_epochs"
+
 # Byzantine scenario plane (sim/scenario.py) counter families.  Both
 # prefixes are suffixed by a consensus/types.py BYZ_* taxonomy token, so
 # the registry's size stays bounded by the fixed taxonomy even when the
@@ -75,7 +96,12 @@ BYZ_FAULTS_PREFIX = "byz_faults_"
 #       recovery observable).
 #   BYZ_DUP_SUPPRESSED — duplicate frames absorbed by the per-sender
 #       LRU before costing a proof re-verification (sim handler path).
+#   WIRE_FRONTIER_REJECTED — a net_state frontier claim failed its
+#       validator signature check (round 9: _certified_frontier counts
+#       only authenticated claims, so a connection that hello'd as a
+#       validator uid cannot mint claims).
 WIRE_SIG_REJECTED = "wire_sig_rejected"
+WIRE_FRONTIER_REJECTED = "wire_frontier_rejected"
 WIRE_SRC_SPOOF = "wire_src_spoof"
 PEER_DISCONNECTS = "peer_disconnects"
 WIRE_RETRY_ABANDONED = "wire_retry_abandoned"

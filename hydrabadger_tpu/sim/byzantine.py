@@ -266,6 +266,27 @@ class DkgCorrupt(Strategy):
         self.log.note(self.kind, len(garbage))
 
 
+class KeygenWithhold(Strategy):
+    """Never ship our DKG traffic: pending keygen messages (our Part,
+    our Acks, our cutover marker) are cleared before every proposal, so
+    the shadow DKG this node should feed starves.  With enough
+    withholding colluders the era switch stalls FOREVER while the
+    current era keeps committing — the scenario the round-9 stall
+    observable exists for: the contract requires the stall to surface
+    loudly (``dhb: shadow keygen stalled`` faults + the
+    ``shadow_dkg_stall_epochs`` gauge), never to wedge the commit
+    path."""
+
+    kind = T.BYZ_KEYGEN_WITHHOLD
+
+    def before_propose(self, node: "ByzantineNode") -> None:
+        core = node.unwrap()
+        pending = getattr(core, "pending_kg", None)
+        if pending:
+            self.log.note(self.kind, len(pending))
+            pending.clear()
+
+
 class ReplayFlood(Strategy):
     """Replay other senders' recent frames under OUR identity, ``burst``
     per handled delivery — the sim analogue of the wire-replay floods
@@ -310,6 +331,7 @@ STRATEGIES = {
     "garbage_shares": GarbageShares,
     "withhold_shares": WithholdShares,
     "dkg_corrupt": DkgCorrupt,
+    "keygen_withhold": KeygenWithhold,
     "replay_flood": ReplayFlood,
 }
 

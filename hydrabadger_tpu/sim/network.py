@@ -275,6 +275,11 @@ class SimNetwork:
         self._txn_counter = 0
         self.total_wall_s = 0.0  # cumulative across run() calls / resumes
         self.epoch_durations: List[float] = []  # seconds, per run_epoch
+        # shadow-DKG era-gap accounting (round 9): the highest era any
+        # node has reached, and the steady-state (no live keygen, no
+        # era flip) epoch durations the era_commit_gap_s bound divides by
+        self._era_seen = 0
+        self._steady_durations: List[float] = []
         # per-sender duplicate-frame LRU (ROADMAP item 5 headroom): a
         # replayed frame costs every receiver a full proof
         # re-verification, which is what dominated the 16-node 0.68x
@@ -304,6 +309,17 @@ class SimNetwork:
         self.__dict__.setdefault("scenario_log", None)
         self.__dict__.setdefault("_dup_seen", {})
         self.__dict__.setdefault("_dup_ids", frozenset(self.ids))
+        # pre-round-9 snapshots lack the field: seed from the restored
+        # cores' actual eras, or the first resumed epoch would read as
+        # an era switch and pollute the era_commit_gap_s high-water
+        self.__dict__.setdefault(
+            "_era_seen",
+            max(
+                (getattr(self.nodes[nid], "era", 0) for nid in self.ids),
+                default=0,
+            ),
+        )
+        self.__dict__.setdefault("_steady_durations", [])
         if getattr(self.router, "drain_hook", None) is None:
             self.router.drain_hook = self._drain_async
 
@@ -437,11 +453,71 @@ class SimNetwork:
         ):
             self._run_epoch_inner()
             self._drain_async()
+        self._note_era_gap()
         # events emitted outside a router delivery (propose calls, the
         # native-ACS batch application) are still pending: the epoch
         # boundary is the sim's other I/O boundary
         if self.recorder.enabled:
             self.recorder.stamp(time.perf_counter())
+
+    def _note_era_gap(self) -> None:
+        """Stamp the round-9 era-cutover gauges after each epoch: the
+        committed-epoch gap across the era-switch window (keygen live
+        or era flipped — obs.metrics.ERA_COMMIT_GAP_S) vs the steady
+        durations it is bounded against, plus the loud-stall mirror of
+        dhb.shadow_stall_epochs()."""
+        if not self.epoch_durations:
+            return
+        dur = self.epoch_durations[-1]
+        kg_live = any(
+            getattr(self.nodes[nid], "key_gen", None) is not None
+            for nid in self.ids
+        )
+        era_now = 0
+        stall = 0
+        for nid in self.ids:
+            era_now = max(era_now, getattr(self.nodes[nid], "era", 0))
+            fn = getattr(self.nodes[nid], "shadow_stall_epochs", None)
+            if fn is not None:
+                stall = max(stall, fn())
+        switched = era_now != self._era_seen
+        self._era_seen = era_now
+        if kg_live or switched:
+            self.metrics.gauge("era_commit_gap_s").track(round(dur, 4))
+        elif len(self._steady_durations) < 4096:
+            self._steady_durations.append(dur)
+        self.metrics.gauge("shadow_dkg_stall_epochs").track(stall)
+
+    def steady_epoch_p50(self) -> float:
+        """Median steady-state epoch wall (no live keygen, no era flip)
+        — the denominator of the era-gap bound."""
+        if not self._steady_durations:
+            return 0.0
+        ordered = sorted(self._steady_durations)
+        return ordered[len(ordered) // 2]
+
+    def era_gap_snapshot(self) -> dict:
+        """The era-cutover gauges as one row-embeddable dict WITH device
+        provenance: a CPU-only capture of ``era_commit_gap_s`` carries
+        ``device_backend``/``device_overlap_has_device`` like the PR-6
+        overlap gauges, so it cannot masquerade as a TPU recapture."""
+        from ..crypto import futures as _futures
+        from ..crypto.dkg import shadow_scheduling
+
+        gap = self.metrics.gauge("era_commit_gap_s").high_water
+        steady = self.steady_epoch_p50()
+        backend = _futures.device_backend()
+        return {
+            "era_commit_gap_s": round(gap, 4),
+            "steady_epoch_p50_s": round(steady, 4),
+            "era_gap_vs_steady": round(gap / steady, 2) if steady else 0.0,
+            "shadow_dkg": shadow_scheduling(),
+            "shadow_dkg_stall_epochs": self.metrics.gauge(
+                "shadow_dkg_stall_epochs"
+            ).high_water,
+            "device_backend": backend,
+            "device_overlap_has_device": 1 if backend in ("tpu", "gpu") else 0,
+        }
 
     def _drain_async(self) -> None:
         """Tick-boundary drain of the hbasync plane: settle every

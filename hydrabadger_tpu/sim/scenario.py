@@ -102,6 +102,16 @@ FAULT_OBSERVABLES: Dict[str, ObsSpec] = {
         ),
         counters=("byz_dup_suppressed",),
     ),
+    T.BYZ_KEYGEN_WITHHOLD: ObsSpec(
+        # withheld DKG Parts/Acks stall the SHADOW era while the current
+        # era keeps committing; the declared observable is the dhb stall
+        # detector — the periodic fault and the harness-mirrored gauge
+        # (obs.metrics.SHADOW_DKG_STALL_EPOCHS).  "shadow keygen
+        # stalled" is strictly longer than BYZ_DKG_CORRUPT's "keygen"
+        # token, so exclusive attribution separates the two families.
+        fault_any=("shadow keygen stalled",),
+        gauges=("shadow_dkg_stall_epochs",),
+    ),
     T.BYZ_WITHHELD_SHARE: _self_counter(T.BYZ_WITHHELD_SHARE),
     T.BYZ_LINK_DROP: _self_counter(T.BYZ_LINK_DROP),
     T.BYZ_LINK_DUP: _self_counter(T.BYZ_LINK_DUP),

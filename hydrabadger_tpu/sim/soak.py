@@ -214,6 +214,80 @@ def byz_soak(epochs: int = 200, n_nodes: int = 4,
     }
 
 
+def era_soak(n_nodes: int = 16, steady_epochs: int = 6,
+             era_gap_floor_s: float = 2.0) -> Dict:
+    """Era-switch gate (round 9, shadow DKG): a dhb sim crosses >= 1
+    era with the shadow-DKG plane on and asserts the committed-epoch
+    gap across the switch stays bounded — the stop-the-world wall
+    (config-5's 181 s at 64 nodes) must not come back.
+
+    The bound is ``max(2x steady-state p50, era_gap_floor_s)``: the 2x
+    relative target is the bench-scale claim (config-5 epochs carry
+    thousands of txns), while at CI scale the steady epochs are
+    milliseconds and the small absolute floor absorbs scheduler jitter
+    — both numbers are recorded in the row so the ratio is auditable.
+    Also asserts the switch actually happened, agreement held, and the
+    stall observable stayed SILENT (a loud stall during a healthy
+    switch would be a false alarm; a wedge would fail the switch
+    assertion).  Row fields carry device provenance: a CPU-only capture
+    of ``era_commit_gap_s`` cannot masquerade as a TPU recapture."""
+    from .network import SimConfig, SimNetwork
+
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=n_nodes, protocol="dhb",
+            txns_per_node_per_epoch=max(1, 256 // n_nodes), txn_bytes=8,
+            seed=23,
+        )
+    )
+    net.run(steady_epochs)
+    victim = net.ids[-1]
+    for nid in net.ids:
+        if nid != victim:
+            net.router.dispatch_step(
+                nid, net.nodes[nid].vote_to_remove(victim)
+            )
+    switched_at = None
+    m = None
+    for i in range(24):
+        m = net.run(1)
+        assert m.agreement_ok, "era soak lost agreement mid-switch"
+        if all(
+            net.nodes[nid].era > 0 for nid in net.ids if nid != victim
+        ):
+            switched_at = i + 1
+            break
+    assert switched_at is not None, (
+        "era never switched under shadow DKG (cutover wedged?)"
+    )
+    m = net.run(2)  # the NEW era commits steady epochs too
+    assert m.agreement_ok, "era soak lost agreement post-switch"
+    net.shutdown()
+    gap = net.era_gap_snapshot()
+    bound = max(2.0 * gap["steady_epoch_p50_s"], era_gap_floor_s)
+    assert gap["era_commit_gap_s"] <= bound, (
+        f"era commit gap {gap['era_commit_gap_s']:.3f}s exceeded the "
+        f"bound {bound:.3f}s (steady p50 "
+        f"{gap['steady_epoch_p50_s']:.3f}s) — the era-switch wall is "
+        "back"
+    )
+    # the stall detector must stay silent through a HEALTHY switch
+    stall_faults = [
+        f for _nid, f in net.router.faults
+        if "shadow keygen stalled" in f.kind
+    ]
+    assert not stall_faults, stall_faults
+    return {
+        "tier": f"era_switch_{n_nodes}node_shadow_dkg",
+        "epochs": m.epochs_done,
+        "epochs_per_sec": round(m.epochs_per_sec, 2),
+        "era_epochs_to_switch": switched_at,
+        "era_gap_bound_s": round(bound, 4),
+        **gap,
+        "agreement_ok": True,
+    }
+
+
 def wire_chaos_soak(epochs: int = 8) -> Dict:
     """Wire-tier chaos gate (ROADMAP item 5's TCP headroom): the
     canonical 4-node full-crypto cluster with f=1 Byzantine peer, link
@@ -363,6 +437,13 @@ def main(argv=None) -> int:
     p.add_argument("--skip-tcp", action="store_true")
     p.add_argument("--skip-byz", action="store_true")
     p.add_argument("--skip-wire", action="store_true")
+    p.add_argument("--skip-era", action="store_true")
+    p.add_argument("--era-only", action="store_true",
+                   help="run ONLY the era-switch gate (shadow-DKG "
+                   "cutover crossing >= 1 era with the commit-gap "
+                   "bound asserted; a scripts/test-all gate)")
+    p.add_argument("--era-nodes", type=int, default=16,
+                   help="node count for the era-switch tier")
     p.add_argument("--byz-only", action="store_true",
                    help="run ONLY the Byzantine liveness-under-attack "
                    "tier (the scripts/test-all SOAK gate)")
@@ -377,16 +458,20 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     results = []
-    only = args.byz_only or args.wire_only
+    only = args.byz_only or args.wire_only or args.era_only
     if not only:
         r = sim_soak(args.epochs)
         print(json.dumps(r), flush=True)
         results.append(r)
-    if not args.skip_byz and not args.wire_only:
+    if args.era_only or (not only and not args.skip_era):
+        r = era_soak(args.era_nodes)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if not args.skip_byz and not args.wire_only and not args.era_only:
         r = byz_soak(args.byz_epochs or max(20, args.epochs // 5))
         print(json.dumps(r), flush=True)
         results.append(r)
-    if not args.skip_wire and not args.byz_only:
+    if not args.skip_wire and not args.byz_only and not args.era_only:
         r = wire_chaos_soak(args.wire_epochs)
         print(json.dumps(r), flush=True)
         results.append(r)

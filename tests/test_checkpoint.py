@@ -212,6 +212,60 @@ class TestMidEraCrash:
             for d in resumed.nodes.values()
         }
 
+    def test_snapshot_between_seal_and_cutover_resumes_identically(self):
+        """Round-9 satellite: checkpoint in the narrowest cutover window
+        — the shadow DKG is COMPLETE (transcript sealed, keys
+        pre-generated, cutover markers in flight) but the cutover batch
+        has not committed — and the resumed run must commit
+        byte-identical batches and the same new-era pk_set as an
+        uninterrupted twin."""
+        total = 8
+        straight = self._voted_sim(seed=31)
+        straight.run(total)
+        assert any(d.era > 0 for d in straight.nodes.values()), (
+            "era never switched: the scenario does not cover the cutover"
+        )
+
+        interrupted = self._voted_sim(seed=31)
+        done = 0
+        caught = False
+        while done < total:
+            interrupted.run(1)
+            done += 1
+            sealed = [
+                nid for nid in interrupted.ids
+                if interrupted.nodes[nid].key_gen is not None
+                and interrupted.nodes[nid].key_gen.sealed
+            ]
+            if sealed and all(
+                d.era == 0 for d in interrupted.nodes.values()
+            ):
+                caught = True
+                break
+        assert caught, "sealed-but-uncommitted cutover window never seen"
+        # the window really is mid-cutover: keys pre-generated in the
+        # shadow, the flip not yet committed anywhere
+        assert any(
+            interrupted.nodes[nid].key_gen.gen_cache is not None
+            for nid in sealed
+        ), "no node had pre-generated era keys at the snapshot"
+        interrupted._drain_async()
+        blob = ckpt.sim_to_bytes(interrupted)
+        resumed = ckpt.sim_from_bytes(blob)
+        resumed.run(total - done)
+
+        a = {n: _batch_keys(straight.nodes[n]) for n in straight.ids}
+        b = {n: _batch_keys(resumed.nodes[n]) for n in resumed.ids}
+        assert a == b
+        assert any(d.era > 0 for d in resumed.nodes.values())
+        assert {
+            (d.era, d.netinfo.pk_set.to_bytes())
+            for d in straight.nodes.values()
+        } == {
+            (d.era, d.netinfo.pk_set.to_bytes())
+            for d in resumed.nodes.values()
+        }
+
 
 class TestCli:
     def test_checkpoint_and_resume_flags(self, tmp_path, capsys):

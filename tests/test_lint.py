@@ -13,6 +13,7 @@ from hydrabadger_tpu.lint import (
     async_fetch,
     callgraph,
     deadcode,
+    env_flags,
     jit_hygiene,
     limb_layout,
     mosaic,
@@ -327,6 +328,56 @@ def test_eager_fetch_allows_registered_fetch_points(tmp_path):
 
 
 # -- suppression mechanics ---------------------------------------------------
+
+
+def test_env_flag_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "crypto/bad_env.py",
+        """\
+        import os
+
+        def gate():
+            a = os.environ.get("HYDRABADGER_BOGUS_FLAG", "")
+            b = os.getenv("HYDRABADGER_ANOTHER_ROGUE")
+            c = os.environ["HYDRABADGER_SUBSCRIPT_ROGUE"]
+            ok = os.environ.get("HYDRABADGER_NTT", "1")  # registered
+            var = "HYDRABADGER_DYNAMIC"
+            d = os.environ.get(var)  # variable name: out of scope
+            return a, b, c, ok, d
+        """,
+    )
+    findings = env_flags.check(sf)
+    flagged = {f.message.split("'")[1] for f in findings}
+    assert flagged == {
+        "HYDRABADGER_BOGUS_FLAG",
+        "HYDRABADGER_ANOTHER_ROGUE",
+        "HYDRABADGER_SUBSCRIPT_ROGUE",
+    }
+
+
+def test_env_flag_registry_is_live():
+    """Every ENV_FLAGS entry must still be READ somewhere in the
+    package — a stale inventory is as misleading as a missing one.
+    Read-sites are extracted via the rule's own AST helper (NOT a raw
+    substring scan, which would match the registry's own definitions
+    and make the check vacuous)."""
+    from hydrabadger_tpu.lint import env_flags, iter_sources, registry
+
+    read = set()
+    import ast as _ast
+
+    for sf in iter_sources():
+        if sf.relpath.startswith("lint/"):
+            continue  # the inventory itself doesn't count as a reader
+        for node in _ast.walk(sf.tree):
+            name = env_flags._env_name(node)
+            if name:
+                read.add(name)
+    stale = sorted(set(registry.ENV_FLAGS) - read)
+    assert not stale, f"ENV_FLAGS entries no source reads: {stale}"
+    # sanity: the helper really extracts (the scan isn't itself vacuous)
+    assert "HYDRABADGER_SHADOW_DKG" in read
 
 
 def test_suppression_with_justification_silences(tmp_path):

@@ -601,9 +601,13 @@ def test_replay_backoff_rate_limits_a_sustained_flood():
     """Regression for the PR-2 `_last_replay_t` gate under sustained
     flood: a genuinely wedged epoch polling the gate every tick must be
     rate-limited to the declared cadence — inter-replay spacing doubles
-    up to 16x the stall threshold regardless of stall age — and every
-    suppressed tick must be counted, not silent."""
-    from hydrabadger_tpu.net.node import EPOCH_REPLAY_TICK_S
+    with the backoff but the COMBINED schedule clamps to the jittered
+    REPLAY_GAP_CEILING_S (round 9) — and every suppressed tick must be
+    counted, not silent."""
+    from hydrabadger_tpu.net.node import (
+        EPOCH_REPLAY_TICK_S,
+        REPLAY_GAP_CEILING_S,
+    )
 
     node = Hydrabadger(
         InAddr("127.0.0.1", BASE_PORT + 97), fast_config(), seed=9
@@ -618,13 +622,15 @@ def test_replay_backoff_rate_limits_a_sustained_flood():
         if node._replay_due(float(tick)):
             fired.append(tick)
     # the flood is bounded by the declared schedule: doubling gaps
-    # (3,9,21,45,93) then one replay per 16x-threshold interval — NOT
-    # one per tick and NOT the 1/s revert the pre-`_last_replay_t`
-    # gate degraded to
-    assert fired[:5] == [3, 9, 21, 45, 93]
-    steady = [b - a for a, b in zip(fired[4:], fired[5:])]
-    assert steady and all(gap == 16 * threshold for gap in steady)
-    assert len(fired) <= 5 + horizon / (16 * threshold) + 1
+    # (3, 9, 21) until the backoff meets the ceiling, then one replay
+    # per jittered-ceiling interval — NOT one per tick and NOT the 1/s
+    # revert the pre-`_last_replay_t` gate degraded to
+    assert fired[:3] == [3, 9, 21]
+    lo = 0.8 * REPLAY_GAP_CEILING_S
+    hi = 1.2 * REPLAY_GAP_CEILING_S + 1  # integer-tick rounding slack
+    steady = [b - a for a, b in zip(fired[2:], fired[3:])]
+    assert steady and all(lo <= gap <= hi for gap in steady), steady
+    assert len(fired) <= 3 + horizon / lo + 1
     assert node.metrics.counter("epoch_replays").value == len(fired)
     # every suppressed wedged tick is observable (ticks before the
     # stall threshold are "not stalled yet", neither fired nor
@@ -637,3 +643,35 @@ def test_replay_backoff_rate_limits_a_sustained_flood():
     node._last_progress_t = float(horizon)
     assert not node._replay_due(float(horizon) + threshold / 2)
     assert node._replay_due(float(horizon) + threshold)
+
+
+def test_replay_gap_ceiling_bounds_compounded_backoff():
+    """The config-12 worst-gap regression (round 9): an epoch-duration
+    EMA inflated by a fault window (60 s) times the 16x backoff used to
+    hold replays minutes apart — 80 s observed — exactly when replay
+    was the only healer.  The jittered ceiling bounds BOTH the stall
+    threshold and the inter-replay spacing: no two consecutive replays
+    may sit more than 1.2x REPLAY_GAP_CEILING_S apart."""
+    from hydrabadger_tpu.net.node import REPLAY_GAP_CEILING_S
+
+    node = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 98), fast_config(), seed=11
+    )
+    node._last_progress_t = 0.0
+    node._last_replay_t = 0.0
+    node._epoch_ema_s = 60.0  # fault-window-inflated estimate
+    node._replay_backoff = 16.0  # already fully backed off
+    threshold = 3.0 * 60.0  # EMA-honest stall detection, uncapped
+    fired = []
+    for tick in range(1, 901):
+        if node._replay_due(float(tick)):
+            fired.append(tick)
+    bound = 1.2 * REPLAY_GAP_CEILING_S + 1
+    # stall detection stays EMA-honest: nothing fires before 3x the
+    # (inflated) epoch estimate — a slow epoch is not a stall ...
+    assert fired and fired[0] == int(threshold), fired[:3]
+    # ... but once stalled, the worst INTER-replay gap stays under the
+    # ceiling bound (the uncapped schedule: 16 * 180 s = 2880 s
+    # between replays — the config-12 compounding)
+    gaps = [b - a for a, b in zip(fired, fired[1:])]
+    assert gaps and max(gaps) <= bound, gaps

@@ -356,6 +356,84 @@ def test_frontier_claims_only_from_validators():
     assert node._ff_claims == {}
 
 
+def _validator_node_with_keys(port: int, n: int = 4):
+    """_validator_node plus the peers' identity secret keys, for tests
+    that must SIGN frontier claims as those peers."""
+    from hydrabadger_tpu.consensus.dynamic_honey_badger import (
+        DynamicHoneyBadger,
+    )
+    from hydrabadger_tpu.consensus.types import NetworkInfo
+    from hydrabadger_tpu.crypto import threshold as th
+
+    node = Hydrabadger(InAddr("127.0.0.1", port), fast_config(), seed=7)
+    rng = random.Random(13)
+    ids = sorted([node.uid.bytes] + [Uid().bytes for _ in range(n - 1)])
+    sks = th.SecretKeySet.random((n - 1) // 3, rng)
+    share = sks.secret_key_share(ids.index(node.uid.bytes))
+    netinfo = NetworkInfo(node.uid.bytes, ids, sks.public_keys(), share)
+    id_sks = {nid: th.SecretKey.random(rng) for nid in ids}
+    id_sks[node.uid.bytes] = node.secret_key
+    pub_keys = {nid: sk.public_key() for nid, sk in id_sks.items()}
+    node.dhb = DynamicHoneyBadger(
+        node.uid.bytes, node.secret_key, netinfo, pub_keys,
+        encrypt=False, coin_mode="hash", verify_shares=False,
+        rng=random.Random(5), session_id=b"net",
+    )
+    node.state = "validator"
+    peers = [nid for nid in ids if nid != node.uid.bytes]
+    return node, peers, id_sks
+
+
+def test_frontier_claims_require_validator_signature():
+    """Round-9 satellite: _certified_frontier counts only AUTHENTICATED
+    claims.  A connection that hello'd as a validator uid but cannot
+    sign under that validator's COMMITTED identity key mints nothing —
+    the forged-claim hole the unsigned gossip left open; a genuinely
+    signed claim from the same peer is recorded."""
+    node, peers, id_sks = _validator_node_with_keys(BASE_PORT + 95)
+    claimant = peers[0]
+    plan = node.dhb.join_plan()
+    roster = tuple(plan.node_ids)
+    validator_pks = tuple((n, plan.pub_keys[n]) for n in roster)
+    claimed_epoch = 40
+
+    def claim(sig_bytes):
+        return (
+            "active", plan.era, claimed_epoch, roster,
+            dict(plan.pub_keys), plan.pk_set_bytes, plan.session_id,
+            (), sig_bytes,
+        )
+
+    class P:
+        uid = Uid(claimant)
+        out_addr = OutAddr("127.0.0.1", 1)
+
+    # forged: signed by the WRONG key (the attacker's own)
+    wrong = Hydrabadger(
+        InAddr("127.0.0.1", BASE_PORT + 99), fast_config(), seed=77
+    )
+    doc = node._frontier_doc(
+        plan.era, claimed_epoch, roster, validator_pks,
+        plan.pk_set_bytes, plan.session_id,
+    )
+    node._note_frontier_claim(claim(wrong.secret_key.sign(doc).to_bytes()), P())
+    assert node._ff_claims == {}
+    assert node.metrics.counter("wire_frontier_rejected").value == 1
+    assert any(
+        f.kind == "wire: frontier claim rejected" for _n, f in node.fault_log
+    )
+    # garbage signature bytes: rejected on the same path, no crash
+    node._note_frontier_claim(claim(b"not-a-signature"), P())
+    assert node._ff_claims == {}
+    # genuine: signed by the claimed validator's committed identity key
+    node._note_frontier_claim(
+        claim(id_sks[claimant].sign(doc).to_bytes()), P()
+    )
+    assert claimant in node._ff_claims
+    assert node._ff_claims[claimant][0] == plan.era
+    assert node._ff_claims[claimant][1] == claimed_epoch
+
+
 def test_era_ahead_adoption_needs_f_plus_one_matching_payloads():
     """The certification covers the PLAN PAYLOAD, not just the ordinal:
     a Byzantine validator riding an honest (era, epoch) with a forged

@@ -1090,6 +1090,52 @@ def _wire_chaos_config12(epochs: int = 10) -> dict:
     }
 
 
+def _process_chaos_config13(epochs: int = 3) -> dict:
+    """Round-10 process-tier chaos row: the robustness twin of config 12
+    one layer further down — every validator is a REAL OS process
+    (``python -m hydrabadger_tpu`` per node, full crypto tier), the
+    supervisor (net/cluster.py) SIGKILLs one mid-era and restarts it
+    from its on-disk generational checkpoint.  The run asserts honest-
+    quorum liveness across the kill, cross-process batch/pk_set
+    agreement, graceful SIGTERM exits, and the process-tier
+    fault-observability contract (a kill with no recovery trace —
+    welcome-back replay, f+1 fast-forward, or observer re-adoption —
+    fails).  Headline metrics: commit gap under a real SIGKILL and the
+    restarted process's catch-up time."""
+    from hydrabadger_tpu.crypto import futures as _futures
+    from hydrabadger_tpu.net.cluster import run_process_chaos
+
+    row = run_process_chaos(
+        epochs=epochs, base_port=3950, fast_crypto=False, deadline_s=600.0
+    )
+    overlap = _futures.overlap_snapshot()
+    return {
+        "metric": "process_chaos_commit_gap_s_4node_full_crypto",
+        "value": row["commit_gap_max_s"],
+        "unit": "s (longest inter-commit gap under a real SIGKILL)",
+        "recovery_catchup_s": row["recovery_catchup_s"],
+        "epochs_per_sec_under_fault": row["epochs_per_sec"],
+        # provenance rides the row like config-5/12: the children pin
+        # JAX_PLATFORMS=cpu (consensus workloads), so this reports the
+        # SUPERVISOR host's backend honestly rather than implying the
+        # killed processes ran device crypto
+        "device_backend": overlap["device_backend"],
+        "device_overlap_has_device": overlap.get(
+            "device_overlap_has_device", 0
+        ),
+        "run": row,
+        "note": (
+            "4 real OS processes (one python -m hydrabadger_tpu per "
+            "validator, full crypto), one real SIGKILL mid-era + "
+            "restart from the on-disk generational checkpoint; honest "
+            "quorum committed throughout, batches byte-identical across "
+            "processes, every child exited 0 on SIGTERM with a final "
+            "durable checkpoint, and the supervisor-tier observability "
+            "contract held (kill surfaced as a recovery trace)"
+        ),
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1097,7 +1143,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
@@ -1112,7 +1158,9 @@ def main(argv=None) -> int:
         "liveness-under-attack (4/16-node full-crypto sim, f attacking "
         "nodes vs the honest twin), 12 = wire-tier chaos (4-node TCP, "
         "f=1 Byzantine peer + link faults + crash/restart; commit gap "
-        "and recovery catch-up time)",
+        "and recovery catch-up time), 13 = process-tier chaos (4 real "
+        "OS processes, real SIGKILL + disk-checkpoint restart; commit "
+        "gap and recovery catch-up under a genuine process death)",
     )
     p.add_argument(
         "--epochs",
@@ -1204,6 +1252,10 @@ def main(argv=None) -> int:
             # adversarial TCP cluster is a host-side robustness row)
             ("config12_wire_chaos",
              lambda: _wire_chaos_config12(epochs_or(10)), "always"),
+            # process-tier chaos: real OS processes on the host either
+            # way (the children pin JAX_PLATFORMS=cpu by design)
+            ("config13_process_chaos",
+             lambda: _process_chaos_config13(epochs_or(3)), "always"),
         ]
         jax_ok = not probe.get("error")
         backend_lost = False
@@ -1334,6 +1386,8 @@ def main(argv=None) -> int:
         return single(lambda: _byz_liveness_config11(epochs_or(20)))
     if args.config == 12:
         return single(lambda: _wire_chaos_config12(epochs_or(10)))
+    if args.config == 13:
+        return single(lambda: _process_chaos_config13(epochs_or(3)))
 
     # config 3 (also the fall-through for the bare invocation)
     return single(_rs_throughput_config3)

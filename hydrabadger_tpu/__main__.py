@@ -125,7 +125,44 @@ def make_parser() -> argparse.ArgumentParser:
         default=None,
         metavar="PATH",
         help="dump the node's metrics registry (queue depth/high-water "
-        "gauges, per-kind wire counters, epoch histograms) as JSON on exit",
+        "gauges, per-kind wire counters, epoch histograms) as JSON on exit; "
+        "a .jsonl path with --metrics-interval streams machine-readable "
+        "fault/metrics summary lines instead (the process-tier "
+        "supervisor's observability feed)",
+    )
+    p.add_argument(
+        "--metrics-interval",
+        type=float,
+        default=0.0,
+        metavar="S",
+        help="with a .jsonl --metrics path: append one summary line "
+        "(state, counters, gauge high-waters, fault-ring kinds) every S "
+        "seconds plus a final line on exit; 0 = exit-only dump",
+    )
+    p.add_argument(
+        "--checkpoint",
+        default=None,
+        metavar="PATH",
+        help="durable generational checkpoint store: persist an "
+        "era/epoch-stamped NodeCheckpoint here every --checkpoint-every "
+        "committed epochs (+ a final one on graceful stop), and RESUME "
+        "from it at boot when a loadable generation exists — the "
+        "restart-from-disk path a supervisor uses after SIGKILL",
+    )
+    p.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=1,
+        metavar="N",
+        help="committed epochs between durable checkpoints (default 1)",
+    )
+    p.add_argument(
+        "--batch-log",
+        default=None,
+        metavar="PATH",
+        help="append one JSONL line per committed batch (epoch, era, "
+        "contribution digest, pk_set digest) — the cross-process "
+        "agreement/identity feed the cluster supervisor asserts over",
     )
     p.add_argument(
         "--mine",
@@ -148,7 +185,15 @@ def gen_txns_factory(seed=None):
 
 
 def main(argv=None) -> int:
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.metrics_interval > 0 and not (
+        args.metrics and args.metrics.endswith(".jsonl")
+    ):
+        parser.error(
+            "--metrics-interval streams summary lines and needs a "
+            ".jsonl --metrics path"
+        )
     setup_logging()
     if args.mine:
         from . import blockchain
@@ -166,6 +211,8 @@ def main(argv=None) -> int:
         output_extra_delay_ms=args.output_extra_delay,
         start_epoch=args.start_epoch,
         engine=args.engine,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=max(1, args.checkpoint_every),
     )
     if args.fast_crypto:
         cfg.encrypt = False
@@ -182,11 +229,93 @@ def main(argv=None) -> int:
         obs_logging.attach_recorder(recorder)
 
     host, port = args.bind_address
-    node = Hydrabadger(InAddr(host, port), cfg, seed=args.seed, recorder=recorder)
+    node = None
+    if args.checkpoint:
+        # restart-from-disk: the generational store walks newest to
+        # oldest, rejecting corrupt/truncated generations LOUDLY; only
+        # when no generation loads does the node boot fresh (and then
+        # re-joins through discovery/DKG like any newcomer)
+        from .checkpoint import CheckpointStore
+
+        ckpt = CheckpointStore(args.checkpoint).load()
+        if ckpt is not None:
+            node = Hydrabadger.from_checkpoint(
+                InAddr(host, port), ckpt, cfg, seed=args.seed,
+                recorder=recorder,
+            )
+            print(
+                f"resumed from checkpoint: era {ckpt.era} epoch "
+                f"{ckpt.epoch} ({'validator' if ckpt.sk_share else 'observer'})",
+                file=sys.stderr,
+            )
+    if node is None:
+        node = Hydrabadger(
+            InAddr(host, port), cfg, seed=args.seed, recorder=recorder
+        )
     remotes = [OutAddr(h, p) for h, p in args.remote_address]
 
+    stop_reason = {"why": "exit"}
+    metrics_jsonl = (
+        args.metrics if args.metrics and args.metrics.endswith(".jsonl")
+        else None
+    )
+
+    def summary_line(final: bool) -> dict:
+        """One machine-readable fault/metrics summary: what the
+        process-tier supervisor folds into its observability contract."""
+        import os as _os
+        import time as _t
+
+        snap = node.metrics.snapshot()
+        return {
+            "t": _t.time(),
+            # counters reset when a killed node's replacement process
+            # reuses the same file: the supervisor separates
+            # incarnations by pid before summing
+            "pid": _os.getpid(),
+            "node": node.uid.bytes.hex()[:8],
+            "state": node.state,
+            "final": final,
+            "reason": stop_reason["why"] if final else None,
+            "counters": snap["counters"],
+            "gauges": {
+                k: g["high_water"] for k, g in snap["gauges"].items()
+            },
+            "faults": [f.kind for _nid, f in node.fault_log],
+        }
+
+    def append_summary(final: bool = False) -> None:
+        import json
+
+        with open(metrics_jsonl, "a") as fh:
+            fh.write(json.dumps(summary_line(final)) + "\n")
+            fh.flush()
+
     async def run():
+        import signal as _signal
+
+        loop = asyncio.get_running_loop()
+
+        def _graceful(why: str):
+            # SIGTERM contract: drain async futures, persist a final
+            # checkpoint (both inside node.stop()) and exit 0 — the
+            # supervisor tells a graceful stop from a hard kill by
+            # exactly this exit code
+            stop_reason["why"] = why
+            asyncio.ensure_future(node.stop())
+
+        try:
+            loop.add_signal_handler(
+                _signal.SIGTERM, lambda: _graceful("sigterm")
+            )
+        except (NotImplementedError, RuntimeError):
+            pass  # non-unix event loop: Ctrl-C/stop() remain
+
         async def log_batches():
+            import hashlib
+            import json
+            import time as _t
+
             while True:
                 batch = await node.batch_queue.get()
                 print(
@@ -195,20 +324,52 @@ def main(argv=None) -> int:
                     f"{sum(len(bytes(v)) for v in batch.contributions.values())}B",
                     flush=True,
                 )
+                if args.batch_log:
+                    h = hashlib.sha256()
+                    for p, v in sorted(batch.contributions.items()):
+                        h.update(bytes(p))
+                        h.update(bytes(v))
+                    # the pk_set digest is read from the LIVE core, so
+                    # around an era cutover it may already be the next
+                    # era's — tag it with the era it actually belongs
+                    # to (pk_era), not the batch's, or cross-process
+                    # agreement checks would compare different eras'
+                    # keys under one label
+                    pk_set = hashlib.sha256(
+                        node.dhb.netinfo.pk_set.to_bytes()
+                    ).hexdigest()[:16]
+                    with open(args.batch_log, "a") as fh:
+                        fh.write(json.dumps({
+                            "t": _t.time(),
+                            "epoch": batch.epoch,
+                            "era": batch.era,
+                            "digest": h.hexdigest(),
+                            "pk_era": node.dhb.era,
+                            "pk_set": pk_set,
+                        }) + "\n")
+                        fh.flush()
 
-        task = asyncio.create_task(log_batches())
+        async def summary_loop():
+            while True:
+                await asyncio.sleep(args.metrics_interval)
+                append_summary()
+
+        tasks = [asyncio.create_task(log_batches())]
+        if metrics_jsonl and args.metrics_interval > 0:
+            tasks.append(asyncio.create_task(summary_loop()))
         gen = gen_txns_factory(args.seed)
         try:
             await node.run_node(
                 remotes, lambda c, b: gen(min(c, args.batch_size), b)
             )
         finally:
-            task.cancel()
+            for t in tasks:
+                t.cancel()
 
     try:
         asyncio.run(run())
     except KeyboardInterrupt:
-        pass
+        stop_reason["why"] = "keyboard_interrupt"
     finally:
         if args.trace and recorder is not None:
             from .obs import export as obs_export
@@ -218,7 +379,10 @@ def main(argv=None) -> int:
             else:
                 n = obs_export.write_chrome_trace(recorder.events, args.trace)
             print(f"trace: {n} events -> {args.trace}", file=sys.stderr)
-        if args.metrics:
+        if metrics_jsonl:
+            append_summary(final=True)
+            print(f"metrics stream -> {metrics_jsonl}", file=sys.stderr)
+        elif args.metrics:
             import json
 
             from .obs.metrics import default_registry

@@ -45,6 +45,11 @@ from typing import Dict, Optional, Sequence
 from .consensus.dynamic_honey_badger import DynamicHoneyBadger
 from .consensus.types import NetworkInfo
 from .crypto.threshold import PublicKey, PublicKeySet, SecretKey, SecretKeyShare
+from .obs.metrics import (
+    CHECKPOINT_CORRUPT_REJECTED,
+    CHECKPOINT_GENERATION_FALLBACKS,
+    CHECKPOINTS_PERSISTED,
+)
 from .utils import codec
 
 _MAGIC = b"HBTPUCKP"
@@ -253,14 +258,27 @@ class NodeCheckpoint:
 
 
 def _atomic_write(path: str, blob: bytes) -> None:
-    """Write via temp file + rename so an interrupted save never destroys
-    the previous good checkpoint (the crash the feature exists to survive)."""
+    """Write via temp file + fsync + rename so an interrupted save never
+    destroys the previous good checkpoint (the crash the feature exists
+    to survive).  The directory entry is fsync'd too: after a SIGKILL —
+    or a power cut — the rename itself must be durable, not just the
+    file contents, or a restart could find a directory still pointing
+    at the OLD inode while the new blob sits unreachable."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(blob)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    dirname = os.path.dirname(os.path.abspath(path))
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # exotic filesystem: contents are still fsync'd
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def save_node(path: str, ckpt: NodeCheckpoint) -> None:
@@ -270,6 +288,87 @@ def save_node(path: str, ckpt: NodeCheckpoint) -> None:
 def load_node(path: str) -> NodeCheckpoint:
     with open(path, "rb") as f:
         return NodeCheckpoint.from_bytes(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Durable generational store (the process-tier chaos plane's disk truth)
+# ---------------------------------------------------------------------------
+
+# generations retained on disk: the live file plus its predecessor.  Two
+# is the floor that makes corruption survivable — a crash mid-rotation
+# (or a bad sector under the newest file) falls back to the previous
+# generation instead of re-running the DKG from scratch.
+CKPT_GENERATIONS = 2
+
+
+class CheckpointStore:
+    """Era/epoch-stamped on-disk node checkpoints with rotation and a
+    LOUD corrupt-file fallback.
+
+    ``save`` rotates the current file to ``<path>.1`` and atomically
+    writes the new generation (write-tmp + fsync + rename + dir fsync),
+    so a process killed at ANY instant — including mid-save — leaves at
+    least one loadable generation on disk.  ``load`` walks newest to
+    oldest: a truncated or bit-flipped file is rejected by the container
+    digest, reported through the ``fault`` hook (the supervisor tier's
+    fault-observability plane) and the ``checkpoint_corrupt_rejected`` /
+    ``checkpoint_generation_fallbacks`` counters, and the previous
+    generation is tried.  Only when EVERY generation is unreadable does
+    ``load`` return None (boot fresh)."""
+
+    def __init__(self, path: str, keep: int = CKPT_GENERATIONS,
+                 metrics=None, fault=None):
+        self.path = path
+        self.keep = max(1, int(keep))
+        self.metrics = metrics  # obs MetricsRegistry (optional)
+        self.fault = fault  # callable(kind: str) -> None (optional)
+
+    def generation_paths(self) -> list:
+        """Newest-first paths of every retained generation."""
+        return [self.path] + [
+            f"{self.path}.{i}" for i in range(1, self.keep)
+        ]
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name).inc()
+
+    def save(self, ckpt: NodeCheckpoint) -> None:
+        blob = ckpt.to_bytes()
+        paths = self.generation_paths()
+        # rotate oldest-first so generation k becomes k+1; the newest
+        # slot is then replaced atomically — a kill between the rotate
+        # and the write leaves .1 as the (intact) latest generation,
+        # exactly what load() falls back to
+        for i in range(self.keep - 1, 0, -1):
+            if os.path.exists(paths[i - 1]):
+                os.replace(paths[i - 1], paths[i])
+        _atomic_write(self.path, blob)
+        self._count(CHECKPOINTS_PERSISTED)
+
+    def load(self) -> Optional[NodeCheckpoint]:
+        for gen, path in enumerate(self.generation_paths()):
+            try:
+                ckpt = load_node(path)
+            except FileNotFoundError:
+                continue
+            except (CheckpointError, OSError, ValueError) as e:
+                # loud rejection: ring + counter, never a silent resume
+                # from garbage — and never a silent *skip* either
+                self._count(CHECKPOINT_CORRUPT_REJECTED)
+                if self.fault is not None:
+                    self.fault("checkpoint: corrupt generation rejected")
+                import logging
+
+                logging.getLogger("hydrabadger_tpu.checkpoint").error(
+                    "checkpoint generation %d (%s) rejected: %s", gen,
+                    path, e,
+                )
+                continue
+            if gen > 0:
+                self._count(CHECKPOINT_GENERATION_FALLBACKS)
+            return ckpt
+        return None
 
 
 # ---------------------------------------------------------------------------

@@ -85,6 +85,9 @@ BYZ_PARTITION = "partition"  # cross-group traffic held until heal
 BYZ_LINK_RESET = "link_reset"  # connection torn down mid-stream (TCP RST)
 BYZ_SIG_CORRUPT = "sig_corrupt"  # frame signature bit-flipped in flight
 BYZ_CRASH = "crash_restart"  # validator SIGKILLed and restarted from checkpoint
+# process-tier-only kind (net/cluster.py): injectable only where each
+# validator is a real OS process whose environment the supervisor owns
+BYZ_CLOCK_SKEW = "clock_skew"  # per-node wall-clock offset/drift injected
 
 BYZ_KINDS = frozenset(
     {
@@ -101,6 +104,7 @@ BYZ_KINDS = frozenset(
         BYZ_LINK_RESET,
         BYZ_SIG_CORRUPT,
         BYZ_CRASH,
+        BYZ_CLOCK_SKEW,
     }
 )
 

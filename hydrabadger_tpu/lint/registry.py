@@ -366,6 +366,16 @@ ENV_FLAGS = {
     "HYDRABADGER_CKPT_KEY": (
         "checkpoint HMAC authentication key (checkpoint.py)"
     ),
+    "HYDRABADGER_CLOCK_SKEW_S": (
+        "process-tier chaos: constant offset (seconds) added to this "
+        "node's replay/backoff/gap timer clock; injected per child by "
+        "the cluster supervisor (net/node, net/cluster)"
+    ),
+    "HYDRABADGER_CLOCK_RATE": (
+        "process-tier chaos: drift rate multiplier on this node's "
+        "timer clock (1.0 = honest; 1.5 = timers run 50% fast, so "
+        "replays/stall declarations fire early) (net/node, net/cluster)"
+    ),
     "HYDRABADGER_LOG": "structured logging level/filter spec (obs/logging)",
     "HYDRABADGER_NO_NATIVE_BLS": (
         "1 disables the native BLS library (crypto/native_bls)"

@@ -164,6 +164,13 @@ class Config:
     wire_sign: bool = True  # BLS-sign/verify every frame (lib.rs:429-447)
     # CryptoEngine backend name — see the class docstring
     engine: str = "cpu"
+    # durable checkpointing (process-tier chaos plane): when set, the
+    # node persists an era/epoch-stamped NodeCheckpoint to this path
+    # (generational store, checkpoint.CheckpointStore) every
+    # ``checkpoint_every`` committed epochs and once more on graceful
+    # stop — the disk artifact a SIGKILL'd process restarts from
+    checkpoint_path: Optional[str] = None
+    checkpoint_every: int = 1
 
 
 class KeyGenMachine:
@@ -384,6 +391,41 @@ class Hydrabadger:
         self._stopped = asyncio.Event()
         self._gen_txns: Optional[Callable[[int, int], List[bytes]]] = None
         self.engine = get_engine(self.cfg.engine)
+        # per-node clock skew (process-tier chaos): the supervisor
+        # injects an offset and/or drift RATE via environment, and this
+        # node's replay/backoff/gap timers read the skewed clock — a
+        # node whose timers run 1.5x fast genuinely replays early and
+        # declares stalls sooner, the OS-level timing tail the
+        # in-process planes cannot model.  Confined to timestamps this
+        # node both WRITES and READS (progress/replay/gap bookkeeping);
+        # cross-object timestamps (peer.born) stay on the host clock.
+        self._clock_offset_s = float(
+            _os.environ.get("HYDRABADGER_CLOCK_SKEW_S") or 0.0
+        )
+        self._clock_rate = float(
+            _os.environ.get("HYDRABADGER_CLOCK_RATE") or 1.0
+        )
+        # the construction-time stamp above predates the skew fields:
+        # re-stamp on the node clock so every later read is coherent
+        self._last_progress_t = self._now()
+        # durable checkpoint store (Config.checkpoint_path): every
+        # rejection/fallback inside the store lands in this node's
+        # fault ring + metrics, so the supervisor-tier observability
+        # contract sees disk corruption exactly like a wire fault
+        self._ckpt_store = None
+        self._ckpt_inflight = None  # at most one executor write in flight
+        if self.cfg.checkpoint_path:
+            from ..checkpoint import CheckpointStore
+
+            self._ckpt_store = CheckpointStore(
+                self.cfg.checkpoint_path,
+                metrics=self.metrics,
+                fault=self._note_fault,
+            )
+
+    def _now(self) -> float:
+        """This node's monotonic clock, with injected skew applied."""
+        return self._clock_offset_s + self._clock_rate * _time.monotonic()
 
     # -- public API (hydrabadger.rs:127-603) --------------------------------
 
@@ -465,6 +507,7 @@ class Hydrabadger:
         config: Optional[Config] = None,
         seed: Optional[int] = None,
         chaos=None,
+        recorder=None,
     ) -> "Hydrabadger":
         """Rebuild a node from a NodeCheckpoint: same identity and keys,
         consensus core fast-forwarded to the saved era/epoch.  The node
@@ -474,7 +517,10 @@ class Hydrabadger:
         handler.rs:256-264).  If the network moved past the saved epoch
         while the node was down, the certified-frontier fast-forward
         (_maybe_fast_forward) catches it up after reconnect."""
-        node = cls(bind, config, uid=Uid(ckpt.uid), seed=seed, chaos=chaos)
+        node = cls(
+            bind, config, uid=Uid(ckpt.uid), seed=seed,
+            recorder=recorder, chaos=chaos,
+        )
         node.secret_key = SecretKey.from_bytes(ckpt.secret_key)
         node.public_key = node.secret_key.public_key()
         node.dhb = node._wrap_dhb(ckpt.restore_dhb(
@@ -554,6 +600,65 @@ class Hydrabadger:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
 
+    def _persist_checkpoint(self, sync: bool = False) -> None:
+        """Write the durable consensus identity to the generational
+        on-disk store (checkpoint.CheckpointStore).  Never raises: a
+        full disk must not take down a committing node — the failure is
+        counted, ringed and logged instead, and the previous generation
+        stays loadable.
+
+        The snapshot is captured synchronously (consensus state mutates
+        under the handler loop) but the DISK work — two fsyncs +
+        rotation — is offloaded to the default executor: inline it
+        would stall the whole wire plane for the fsync latency on
+        every committed epoch, inflating the very commit-gap metric
+        the chaos tiers measure.  One write in flight at a time; an
+        epoch arriving while the previous write is still syncing skips
+        its persist (counted), leaving the cadence ≥ checkpoint_every.
+        ``sync=True`` (graceful stop) writes inline, AFTER any in-
+        flight write has been awaited by the caller."""
+        if self._ckpt_store is None or self.dhb is None:
+            return
+        from ..obs.metrics import CHECKPOINT_PERSIST_FAILURES
+
+        try:
+            ckpt = self.checkpoint()
+        except Exception:
+            self._note_fault(
+                "checkpoint: persist failed", CHECKPOINT_PERSIST_FAILURES
+            )
+            log.exception("checkpoint capture failed")
+            return
+        if sync:
+            try:
+                self._ckpt_store.save(ckpt)
+            except Exception:
+                self._note_fault(
+                    "checkpoint: persist failed", CHECKPOINT_PERSIST_FAILURES
+                )
+                log.exception("checkpoint persist failed")
+            return
+        if self._ckpt_inflight is not None and not self._ckpt_inflight.done():
+            from ..obs.metrics import CHECKPOINT_PERSISTS_SKIPPED
+
+            self.metrics.counter(CHECKPOINT_PERSISTS_SKIPPED).inc()
+            return
+        fut = asyncio.get_event_loop().run_in_executor(
+            None, self._ckpt_store.save, ckpt
+        )
+        self._ckpt_inflight = fut
+
+        def _done(f):
+            try:
+                f.result()
+            except Exception:
+                self._note_fault(
+                    "checkpoint: persist failed", CHECKPOINT_PERSIST_FAILURES
+                )
+                log.exception("checkpoint persist failed")
+
+        fut.add_done_callback(_done)
+
     async def stop(self) -> None:
         self._stopped.set()
         # settle any in-flight keygen flushes: device work must never be
@@ -561,6 +666,22 @@ class Hydrabadger:
         prev, self._kg_prev = self._kg_prev, []
         for entry in prev:
             self._settle_kg_flush(entry)
+        if self.dhb is not None:
+            try:
+                self.dhb.drain_async()
+            except Exception:
+                log.exception("drain_async failed during stop")
+        # graceful-stop contract (SIGTERM tier): the LAST act before the
+        # transport dies is a final durable checkpoint, so a supervisor
+        # that terminated us can restart from the exact stop epoch.
+        # Await any executor write still in flight first — the store's
+        # rotation is not safe under two concurrent writers.
+        if self._ckpt_inflight is not None and not self._ckpt_inflight.done():
+            try:
+                await self._ckpt_inflight
+            except Exception:
+                pass  # already logged by its done-callback
+        self._persist_checkpoint(sync=True)
         if self._server is not None:
             self._server.close()
         self.peers.close_all()
@@ -1258,7 +1379,7 @@ class Hydrabadger:
         # frames of concluded epochs would only cost every receiver a
         # signature check on our next stall replay
         self._epoch_outbox.clear()
-        self._last_progress_t = _time.monotonic()
+        self._last_progress_t = self._now()
         self._replay_backoff = 1.0
         self._note_fault("wire: fast-forward", "node_fast_forwards")
         log.info(
@@ -1596,7 +1717,7 @@ class Hydrabadger:
             # construction — the bootstrap DKG interval must not seed
             # the epoch-duration EMA (it would inflate the stall
             # threshold by minutes exactly when replay matters most)
-            self._last_progress_t = _time.monotonic()
+            self._last_progress_t = self._now()
             log.info("%s validator: era %d, %d nodes", self.uid,
                      self.cfg.start_epoch, len(node_ids))
             # replay messages that arrived during keygen (state.rs:473-514)
@@ -1663,7 +1784,7 @@ class Hydrabadger:
         # and re-added recovers through one (or more) of these adoptions
         self.metrics.counter("observer_adoptions").inc()
         self.state = "observer"
-        self._last_progress_t = _time.monotonic()  # see _maybe_finish_keygen
+        self._last_progress_t = self._now()  # see _maybe_finish_keygen
         log.info("%s observer at era %d epoch %d", self.uid, plan.era, plan.epoch)
         pending, self.iom_queue = self.iom_queue, []
         for src, payload in pending:
@@ -1762,7 +1883,7 @@ class Hydrabadger:
         # current epoch's (and pipelined successors') frames stay.
         while self._epoch_outbox and self._epoch_outbox[0][0] < batch.epoch:
             self._epoch_outbox.popleft()
-        now = _time.monotonic()
+        now = self._now()
         raw_dt = now - self._last_progress_t
         dt = min(raw_dt, 60.0)
         # round 9: committed-epoch gap across the era-switch window (a
@@ -1821,6 +1942,13 @@ class Hydrabadger:
         self.current_epoch = batch.epoch + 1
         # hblint: disable=attacker-taint -- epoch-paced public-API queue; the application consumer owns drain pacing (register via batch_queue)
         self.batch_queue.put_nowait(batch)
+        # durable-checkpoint cadence: epoch-stamped, so a SIGKILL at any
+        # instant restarts at most checkpoint_every epochs stale
+        if (
+            self._ckpt_store is not None
+            and batch.epoch % max(1, self.cfg.checkpoint_every) == 0
+        ):
+            self._persist_checkpoint()
         if batch.join_plan is not None:
             self.peers.wire_to_all(
                 WireMessage("join_plan", batch.join_plan.wire())
@@ -2213,13 +2341,13 @@ class Hydrabadger:
             if len(self.batches) != self._last_progress_batches:
                 self._last_progress_batches = len(self.batches)
                 continue
-            if not self._replay_due(_time.monotonic()):
+            if not self._replay_due(self._now()):
                 continue
             frames = list(self._epoch_outbox)
             log.debug(
                 "%s epoch stalled %.1fs (ema %.1fs): replaying %d frames",
                 self.uid,
-                _time.monotonic() - self._last_progress_t,
+                self._now() - self._last_progress_t,
                 self._epoch_ema_s or EPOCH_REPLAY_TICK_S,
                 len(frames),
             )

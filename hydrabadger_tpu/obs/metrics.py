@@ -100,6 +100,27 @@ BYZ_FAULTS_PREFIX = "byz_faults_"
 #       validator signature check (round 9: _certified_frontier counts
 #       only authenticated claims, so a connection that hello'd as a
 #       validator uid cannot mint claims).
+#   CHECKPOINTS_PERSISTED — durable on-disk checkpoint generations
+#       written (checkpoint.CheckpointStore.save: write-tmp + fsync +
+#       rename + dir fsync, previous generation rotated to .1).
+#   CHECKPOINT_CORRUPT_REJECTED — a truncated/bit-flipped generation
+#       failed the container digest at load and was rejected LOUDLY
+#       (fault-ring entry rides alongside via the store's fault hook).
+#   CHECKPOINT_GENERATION_FALLBACKS — a load served an OLDER generation
+#       because every newer one was missing or rejected.
+#   CHECKPOINT_PERSIST_FAILURES — a periodic/final persist raised (disk
+#       full, permissions): the node keeps committing, the failure is
+#       ringed, and the previous on-disk generation stays loadable.
+#   CHECKPOINT_PERSISTS_SKIPPED — an epoch's persist was skipped because
+#       the previous generation's executor write was still syncing (the
+#       disk is slower than the commit cadence; the node never blocks
+#       its wire plane on an fsync).
+CHECKPOINTS_PERSISTED = "checkpoints_persisted"
+CHECKPOINT_CORRUPT_REJECTED = "checkpoint_corrupt_rejected"
+CHECKPOINT_GENERATION_FALLBACKS = "checkpoint_generation_fallbacks"
+CHECKPOINT_PERSIST_FAILURES = "checkpoint_persist_failures"
+CHECKPOINT_PERSISTS_SKIPPED = "checkpoint_persists_skipped"
+
 WIRE_SIG_REJECTED = "wire_sig_rejected"
 WIRE_FRONTIER_REJECTED = "wire_frontier_rejected"
 WIRE_SRC_SPOOF = "wire_src_spoof"

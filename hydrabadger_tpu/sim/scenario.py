@@ -46,6 +46,11 @@ SELF_COUNTING_KINDS = frozenset(
         T.BYZ_LINK_DUP,
         T.BYZ_LINK_DELAY,
         T.BYZ_PARTITION,
+        # a skewed clock is pure timing: an asynchronous protocol makes
+        # NO timing assumptions, so there is nothing protocol-side to
+        # detect — the declared observable is the injection counter
+        # (process tier, net/cluster.py)
+        T.BYZ_CLOCK_SKEW,
     }
 )
 

@@ -302,6 +302,35 @@ def wire_chaos_soak(epochs: int = 8) -> Dict:
     return run_chaos_cluster(epochs=epochs, base_port=3870)
 
 
+def process_chaos_soak(epochs: int = 6,
+                       rss_budget_mb: float = 64.0) -> Dict:
+    """Process-tier chaos gate (ROADMAP item 3's process-runner half):
+    a 4-node cluster of REAL OS processes (``python -m hydrabadger_tpu``
+    per validator) bootstraps over real sockets, one validator takes a
+    real SIGKILL mid-era and restarts from its on-disk generational
+    checkpoint, and the supervisor (net/cluster.py) asserts
+    honest-quorum liveness, cross-process batch/pk_set agreement,
+    graceful SIGTERM exits (rc 0 + final durable checkpoint) and the
+    process-tier observability contract — a kill with no recovery
+    trace fails the run.  The row carries the tier's headline
+    robustness metrics: commit gap under a real kill, recovery
+    catch-up seconds, and the supervisor's own flat-RSS check (the
+    feeds are files, so the supervisor must stay O(1) in memory no
+    matter how long the children run)."""
+    from ..net.cluster import run_process_chaos
+
+    # deadline UNDER the scripts/test-all external `timeout -k 15 300`:
+    # the harness's own diagnostic (health report + graceful child
+    # sweep) must fire before the outer kill would orphan anything
+    row = run_process_chaos(epochs=epochs, base_port=3990,
+                            deadline_s=240.0)
+    assert row["supervisor_rss_growth_mb"] < rss_budget_mb, (
+        f"supervisor RSS grew {row['supervisor_rss_growth_mb']:.1f} MB "
+        f"(> {rss_budget_mb})"
+    )
+    return row
+
+
 def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
     """4-node localhost cluster, DEFAULT (full) crypto tier, to
     `epochs` committed batches with queue/RSS bounds sampled live."""
@@ -438,6 +467,7 @@ def main(argv=None) -> int:
     p.add_argument("--skip-byz", action="store_true")
     p.add_argument("--skip-wire", action="store_true")
     p.add_argument("--skip-era", action="store_true")
+    p.add_argument("--skip-proc", action="store_true")
     p.add_argument("--era-only", action="store_true",
                    help="run ONLY the era-switch gate (shadow-DKG "
                    "cutover crossing >= 1 era with the commit-gap "
@@ -454,11 +484,22 @@ def main(argv=None) -> int:
     p.add_argument("--wire-epochs", type=int, default=8,
                    help="wire-chaos tier committed-epoch target "
                    "(full-crypto TCP: each costs ~2 s)")
+    p.add_argument("--proc-only", action="store_true",
+                   help="run ONLY the process-tier chaos gate (real "
+                   "OS processes, real SIGKILL + disk-checkpoint "
+                   "restart, supervisor contract asserted; the "
+                   "scripts/test-all process gate)")
+    p.add_argument("--proc-epochs", type=int, default=6,
+                   help="process-chaos tier committed-epoch target "
+                   "(counted across the armed window, per surviving "
+                   "node)")
     p.add_argument("--out", default="SOAK.json")
     args = p.parse_args(argv)
 
     results = []
-    only = args.byz_only or args.wire_only or args.era_only
+    only = (
+        args.byz_only or args.wire_only or args.era_only or args.proc_only
+    )
     if not only:
         r = sim_soak(args.epochs)
         print(json.dumps(r), flush=True)
@@ -467,12 +508,20 @@ def main(argv=None) -> int:
         r = era_soak(args.era_nodes)
         print(json.dumps(r), flush=True)
         results.append(r)
-    if not args.skip_byz and not args.wire_only and not args.era_only:
+    if not args.skip_byz and not (
+        args.wire_only or args.era_only or args.proc_only
+    ):
         r = byz_soak(args.byz_epochs or max(20, args.epochs // 5))
         print(json.dumps(r), flush=True)
         results.append(r)
-    if not args.skip_wire and not args.byz_only and not args.era_only:
+    if not args.skip_wire and not (
+        args.byz_only or args.era_only or args.proc_only
+    ):
         r = wire_chaos_soak(args.wire_epochs)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if args.proc_only or (not only and not args.skip_proc):
+        r = process_chaos_soak(args.proc_epochs)
         print(json.dumps(r), flush=True)
         results.append(r)
     if not args.skip_tcp and not only:

@@ -105,6 +105,148 @@ class TestNodeCheckpoint:
         assert all(b[0].epoch == epoch0 for b in batches.values())
 
 
+class TestCheckpointStore:
+    """Durable generational store (process-tier chaos): atomic writes,
+    rotation, and the loud corrupt-file fallback."""
+
+    def _ckpt(self, seed=7):
+        net = _dhb_sim(seed=seed)
+        nid = net.ids[0]
+        return ckpt.NodeCheckpoint.capture(net.id_sks[nid], net.nodes[nid])
+
+    def _store(self, tmp_path, metrics=None, faults=None):
+        return ckpt.CheckpointStore(
+            str(tmp_path / "node.ckpt"),
+            metrics=metrics,
+            fault=(lambda kind: faults.append(kind))
+            if faults is not None else None,
+        )
+
+    def test_save_rotates_and_load_prefers_newest(self, tmp_path):
+        store = self._store(tmp_path)
+        cp = self._ckpt()
+        store.save(cp)
+        assert store.load() == cp
+        # a later epoch rotates the old generation to .1
+        cp2 = ckpt.NodeCheckpoint(**{**cp.__dict__, "epoch": cp.epoch + 5})
+        store.save(cp2)
+        paths = store.generation_paths()
+        assert all(ckpt.load_node(p) is not None for p in paths)
+        assert store.load() == cp2
+        assert ckpt.load_node(paths[1]) == cp
+
+    def test_truncated_newest_falls_back_loudly(self, tmp_path):
+        from hydrabadger_tpu.obs.metrics import MetricsRegistry
+
+        metrics, faults = MetricsRegistry(), []
+        store = self._store(tmp_path, metrics, faults)
+        cp = self._ckpt()
+        store.save(cp)
+        cp2 = ckpt.NodeCheckpoint(**{**cp.__dict__, "epoch": cp.epoch + 5})
+        store.save(cp2)
+        # SIGKILL mid-write shape: the newest file is cut short
+        raw = open(store.path, "rb").read()
+        open(store.path, "wb").write(raw[: len(raw) // 2])
+        got = store.load()
+        assert got == cp  # the PREVIOUS generation, not garbage
+        assert metrics.counter("checkpoint_corrupt_rejected").value == 1
+        assert metrics.counter("checkpoint_generation_fallbacks").value == 1
+        assert faults == ["checkpoint: corrupt generation rejected"]
+
+    def test_bitflipped_newest_falls_back_loudly(self, tmp_path):
+        from hydrabadger_tpu.obs.metrics import MetricsRegistry
+
+        metrics, faults = MetricsRegistry(), []
+        store = self._store(tmp_path, metrics, faults)
+        cp = self._ckpt()
+        store.save(cp)
+        cp2 = ckpt.NodeCheckpoint(**{**cp.__dict__, "epoch": cp.epoch + 5})
+        store.save(cp2)
+        raw = bytearray(open(store.path, "rb").read())
+        raw[len(raw) // 2] ^= 0x40  # one flipped bit in the payload
+        open(store.path, "wb").write(bytes(raw))
+        assert store.load() == cp
+        assert metrics.counter("checkpoint_corrupt_rejected").value == 1
+        assert faults, "corruption must hit the fault hook"
+
+    def test_every_generation_bad_returns_none(self, tmp_path):
+        from hydrabadger_tpu.obs.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
+        store = self._store(tmp_path, metrics)
+        cp = self._ckpt()
+        store.save(cp)
+        store.save(cp)
+        for p in store.generation_paths():
+            open(p, "wb").write(b"not a checkpoint at all")
+        assert store.load() is None  # boot fresh, never resume garbage
+        assert metrics.counter("checkpoint_corrupt_rejected").value == 2
+
+    def test_missing_files_load_none_quietly(self, tmp_path):
+        from hydrabadger_tpu.obs.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
+        assert self._store(tmp_path, metrics).load() is None
+        # absent files are a fresh boot, not corruption
+        assert metrics.counter("checkpoint_corrupt_rejected").value == 0
+
+
+@pytest.mark.slow
+class TestCrossProcessRecovery:
+    def test_sigkill_mid_era_restart_matches_uninterrupted_twin(
+        self, tmp_path
+    ):
+        """Satellite pin, at the REAL process boundary: a 4-node
+        process-per-node cluster takes a genuine SIGKILL on one member
+        mid-era, the supervisor restarts it from its on-disk
+        generational checkpoint, and the recovered process's committed
+        batches and pk_set are byte-identical (by digest) to its
+        uninterrupted twins' — while the honest quorum never stopped
+        committing."""
+        import json
+
+        from hydrabadger_tpu.net.cluster import KillSpec, run_process_chaos
+
+        row = run_process_chaos(
+            n=4, epochs=4, base_port=4440, workdir=str(tmp_path),
+            fast_crypto=True,
+            kills=(KillSpec(at_s=1.0, node=1, sig="kill",
+                            restart_after_s=2.0),),
+        )
+        assert row["agreement_ok"] and row["contract_ok"]
+        assert row["epochs"] >= 4
+        assert row["recovery_catchup_s"] is not None
+
+        # re-derive the identity claim straight from the feeds: the
+        # victim's rows (pre-crash AND post-restart incarnations append
+        # to one file) must match a survivor's digests epoch-for-epoch,
+        # and every era's pk_set digest must agree
+        def rows(i):
+            out = {}
+            with open(tmp_path / f"node{i}.batches.jsonl") as fh:
+                for line in fh:
+                    r = json.loads(line)
+                    out[r["epoch"]] = (r["digest"], r["era"], r["pk_set"])
+            return out
+
+        victim, survivor = rows(1), rows(0)
+        shared = set(victim) & set(survivor)
+        assert shared, "victim and survivor share no epochs"
+        for e in shared:
+            assert victim[e] == survivor[e], f"divergence at epoch {e}"
+        # the victim genuinely recommitted at the survivors' frontier
+        # after the kill, not just replayed its pre-crash history
+        assert max(victim) >= max(survivor) - 1, "victim never caught up"
+        # and a recovery trace surfaced (the contract already asserted
+        # this; restate the headline counters for the reader)
+        det = row["detections"]
+        assert (
+            det["welcome_back_replays"] > 0
+            or det["node_fast_forwards"] > 0
+            or det["observer_adoptions"] > 0
+        )
+
+
 class TestSimCheckpoint:
     def test_resume_bit_identical(self):
         cfg = dict(n_nodes=4, protocol="qhb", seed=3)

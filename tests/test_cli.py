@@ -35,6 +35,29 @@ def test_defaults_match_reference():
     assert args.output_extra_delay == 0
 
 
+def test_process_tier_flags_accepted():
+    """Round-10 process-chaos surface: the durable checkpoint store,
+    the JSONL fault/metrics summary stream and the committed-batch feed
+    the cluster supervisor (net/cluster.py) drives children with."""
+    args = make_parser().parse_args(
+        [
+            "--checkpoint", "/tmp/n0.ckpt",
+            "--checkpoint-every", "2",
+            "--metrics", "/tmp/n0.metrics.jsonl",
+            "--metrics-interval", "0.5",
+            "--batch-log", "/tmp/n0.batches.jsonl",
+        ]
+    )
+    assert args.checkpoint == "/tmp/n0.ckpt"
+    assert args.checkpoint_every == 2
+    assert args.metrics_interval == 0.5
+    assert args.batch_log == "/tmp/n0.batches.jsonl"
+    # defaults: no store, exit-only metrics dump
+    d = make_parser().parse_args([])
+    assert d.checkpoint is None and d.metrics_interval == 0.0
+    assert d.batch_log is None and d.checkpoint_every == 1
+
+
 def test_bad_address_rejected():
     with pytest.raises(SystemExit):
         make_parser().parse_args(["-b", "nonsense"])

@@ -1,0 +1,237 @@
+"""Process-tier chaos harness (net/cluster.py): supervisor mechanics,
+the SIGTERM graceful-shutdown contract over a REAL subprocess cluster,
+and the supervisor-tier fault-observability contract — including the
+known-bad pin that a SIGKILL with no recovery trace FAILS the run.
+"""
+import json
+import os
+
+import pytest
+
+from hydrabadger_tpu.consensus import types as T
+from hydrabadger_tpu.net.cluster import (
+    PROC_FAULT_OBSERVABLES,
+    ClusterSupervisor,
+    KillSpec,
+    RestartPolicy,
+    parse_kill_spec,
+    rolling_kills,
+    verify_process_scenario,
+)
+from hydrabadger_tpu.obs.metrics import BYZ_FAULTS_PREFIX
+
+
+# -- schedule grammar ---------------------------------------------------------
+
+
+def test_kill_spec_grammar():
+    assert parse_kill_spec("2:1") == KillSpec(2.0, 1, "kill", None)
+    assert parse_kill_spec("2.5:0:term") == KillSpec(2.5, 0, "term", None)
+    assert parse_kill_spec("5:3:kill:2.5") == KillSpec(5.0, 3, "kill", 2.5)
+    for bad in ("", "5", "1:2:sigquit", "1:2:kill:3:4", "x:1"):
+        with pytest.raises(ValueError):
+            parse_kill_spec(bad)
+
+
+def test_rolling_kills_stagger():
+    ks = rolling_kills(3, start_s=2.0, stagger_s=4.0, down_s=2.5)
+    assert [k.node for k in ks] == [0, 1, 2]
+    assert [k.at_s for k in ks] == [2.0, 6.0, 10.0]
+    assert all(k.restart_after_s == 2.5 for k in ks)
+    # stagger > down: at most one node down at any instant — each
+    # restart lands before the next kill fires
+    for a, b in zip(ks, ks[1:]):
+        assert a.at_s + a.restart_after_s < b.at_s
+
+
+def test_restart_policy():
+    never = RestartPolicy(mode="never")
+    on_fail = RestartPolicy(mode="on_failure", max_restarts=2)
+    always = RestartPolicy(mode="always", max_restarts=2)
+    assert not never.should_restart(-9, 0)
+    assert on_fail.should_restart(-9, 0)  # SIGKILL'd
+    assert not on_fail.should_restart(0, 0)  # graceful exit stays down
+    assert not on_fail.should_restart(-9, 2)  # budget exhausted
+    assert always.should_restart(0, 1)
+    assert not always.should_restart(0, 2)
+
+
+# -- the observability contract ----------------------------------------------
+
+
+def test_clock_skew_is_self_counting():
+    """Clock skew is pure timing — an asynchronous protocol has nothing
+    to detect — so the injection counter IS the declared observable
+    (the sim's stance for withheld shares and link loss)."""
+    from hydrabadger_tpu.sim.scenario import SELF_COUNTING_KINDS
+
+    assert T.BYZ_CLOCK_SKEW in SELF_COUNTING_KINDS
+    assert T.BYZ_CLOCK_SKEW in PROC_FAULT_OBSERVABLES
+    sup = ClusterSupervisor(
+        n=2, workdir="/tmp/hbtpu-test-skew", base_port=4401,
+        clock_skew={1: (0.5, 1.25)},
+    )
+    sup.arm_skew()
+    assert sup.log.counts[T.BYZ_CLOCK_SKEW] == 1
+    assert sup.metrics.counter(
+        BYZ_FAULTS_PREFIX + T.BYZ_CLOCK_SKEW
+    ).value == 1
+    assert verify_process_scenario(sup) == []
+
+
+def test_kill_without_recovery_trace_fails(tmp_path):
+    """THE acceptance pin: the supervisor injected a SIGKILL but no
+    child ever surfaced a recovery trace (welcome-back replay, f+1
+    fast-forward, observer re-adoption) — the contract must RAISE, not
+    shrug."""
+    sup = ClusterSupervisor(n=2, workdir=str(tmp_path), base_port=4403)
+    sup.log.note(T.BYZ_CRASH)
+    violations = verify_process_scenario(sup)
+    assert len(violations) == 1 and T.BYZ_CRASH in violations[0]
+    # any ONE of the three staleness-ordered recovery flows satisfies it
+    sup.metrics.counter("welcome_back_replays").inc()
+    assert verify_process_scenario(sup) == []
+
+
+def test_summaries_merge_across_incarnations(tmp_path):
+    """Counters reset when a killed node's replacement reuses the
+    metrics file: the supervisor must group lines by pid and SUM the
+    incarnations, not take the file's last line."""
+    sup = ClusterSupervisor(n=1, workdir=str(tmp_path), base_port=4405)
+    lines = [
+        # incarnation A: two periodic lines (no final — SIGKILL)
+        {"pid": 100, "node": "aa", "counters": {"epochs_committed": 3},
+         "gauges": {"internal_queue_depth": 7}, "faults": ["wire: x"]},
+        {"pid": 100, "node": "aa", "counters": {"epochs_committed": 5},
+         "gauges": {"internal_queue_depth": 9}, "faults": ["wire: x"]},
+        # incarnation B after restart: counters restart from zero
+        {"pid": 200, "node": "aa",
+         "counters": {"epochs_committed": 2, "node_fast_forwards": 1},
+         "gauges": {"internal_queue_depth": 4},
+         "faults": ["wire: fast-forward"]},
+    ]
+    with open(sup.children[0].metrics_path, "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+        fh.write("{torn-final-line-from-a-sigkill\n")  # must be skipped
+    merged = sup.merged_metrics().snapshot()
+    assert merged["counters"]["epochs_committed"] == 5 + 2
+    assert merged["counters"]["node_fast_forwards"] == 1
+    assert merged["gauges"]["internal_queue_depth"]["high_water"] == 9
+    kinds = [f.kind for _n, f in sup.fault_entries()]
+    assert "wire: fast-forward" in kinds
+    # and the recovery trace satisfies a noted kill
+    sup.log.note(T.BYZ_CRASH)
+    assert verify_process_scenario(sup) == []
+
+
+# -- the node clock (skew injection target) -----------------------------------
+
+
+@pytest.mark.asyncio
+async def test_node_clock_honors_injected_skew(monkeypatch):
+    import time as _time
+
+    from hydrabadger_tpu.net.node import Config, Hydrabadger
+    from hydrabadger_tpu.utils.ids import InAddr
+
+    monkeypatch.setenv("HYDRABADGER_CLOCK_SKEW_S", "120.0")
+    monkeypatch.setenv("HYDRABADGER_CLOCK_RATE", "2.0")
+    skewed = Hydrabadger(InAddr("127.0.0.1", 4407), Config(), seed=1)
+    monkeypatch.delenv("HYDRABADGER_CLOCK_SKEW_S")
+    monkeypatch.delenv("HYDRABADGER_CLOCK_RATE")
+    honest = Hydrabadger(InAddr("127.0.0.1", 4408), Config(), seed=2)
+    now = _time.monotonic()
+    assert abs(honest._now() - now) < 1.0
+    # offset + 2x drift: the skewed node's timers genuinely run fast —
+    # its replay/stall machinery sees double the elapsed wall time
+    assert skewed._now() == pytest.approx(120.0 + 2.0 * now, rel=0.01)
+    a = skewed._now()
+    _time.sleep(0.05)
+    # 0.05 s of wall time reads as ~0.1 s on the 2x-drift clock
+    assert (skewed._now() - a) == pytest.approx(0.1, rel=0.5)
+    # progress stamps were re-taken on the node clock, so the replay
+    # gate's arithmetic stays coherent under skew
+    assert skewed._last_progress_t >= 120.0
+
+
+# -- the SIGTERM graceful-shutdown contract (real subprocesses) ---------------
+
+
+def test_sigterm_graceful_stop_subprocess(tmp_path):
+    """Satellite pin: a real ``python -m hydrabadger_tpu`` child under
+    SIGTERM drains, persists a FINAL durable checkpoint and exits 0 —
+    the exit code a supervisor uses to tell graceful stop from a hard
+    kill — while a SIGKILLed sibling exits nonzero and leaves no final
+    summary line."""
+    from hydrabadger_tpu.checkpoint import CheckpointStore
+
+    sup = ClusterSupervisor(
+        n=3, base_port=4410, workdir=str(tmp_path), fast_crypto=True,
+        txn_interval_ms=100, metrics_interval_s=0.25,
+    )
+    try:
+        sup.start_all()
+        from hydrabadger_tpu.net.cluster import _wait
+
+        _wait(
+            lambda: all(
+                (sup.last_summary(i) or {}).get("state") == "validator"
+                for i in range(3)
+            ),
+            "bootstrap DKG", 120.0, sup,
+        )
+        _wait(
+            lambda: all(sup.frontier(i) >= 1 for i in range(3)),
+            "first commits", 60.0, sup,
+        )
+        # hard kill one node: nonzero rc, no graceful final line
+        sup.kill(2)
+        assert sup.children[2].last_exit != 0
+        final_2 = [s for s in sup.summaries(2) if s.get("final")]
+        assert not final_2, "a SIGKILLed process cannot write a goodbye"
+        # graceful stop another: rc 0 + final line + loadable checkpoint
+        rc = sup.terminate(0)
+        assert rc == 0
+        finals = [s for s in sup.summaries(0) if s.get("final")]
+        assert finals and finals[-1]["reason"] == "sigterm"
+        ckpt = CheckpointStore(sup.children[0].ckpt_path).load()
+        assert ckpt is not None and ckpt.sk_share
+        assert ckpt.epoch >= 1
+    finally:
+        sup.stop_all(timeout_s=10.0)
+
+
+def test_supervisor_watchdog_restarts_on_failure(tmp_path):
+    """Health watchdog: a child that dies OUTSIDE the kill schedule is
+    respawned per RestartPolicy(on_failure) — from its on-disk
+    checkpoint — and the unexpected exit is counted."""
+    import signal as _signal
+    import time as _time
+
+    sup = ClusterSupervisor(
+        n=3, base_port=4420, workdir=str(tmp_path), fast_crypto=True,
+        txn_interval_ms=100, metrics_interval_s=0.25,
+        restart_policy=RestartPolicy(mode="on_failure", backoff_s=0.1),
+    )
+    try:
+        sup.start_all()
+        from hydrabadger_tpu.net.cluster import _wait
+
+        _wait(
+            lambda: all(sup.frontier(i) >= 1 for i in range(3)),
+            "first commits", 120.0, sup,
+        )
+        # simulate an OOM-killer strike the schedule never planned
+        os.kill(sup.children[1].proc.pid, _signal.SIGKILL)
+        t0 = _time.monotonic()
+        while not (
+            sup.children[1].alive and sup.children[1].restarts == 1
+        ):
+            sup.poll()
+            _time.sleep(0.1)
+            assert _time.monotonic() - t0 < 30.0, "watchdog never restarted"
+        assert sup.metrics.counter("proc_unexpected_exits").value == 1
+        assert sup.metrics.counter("proc_restarts").value == 1
+    finally:
+        sup.stop_all(timeout_s=10.0)

@@ -191,15 +191,19 @@ def test_self_counting_kinds_observed_via_their_counter():
 
 def test_every_taxonomy_kind_has_an_observables_entry():
     """No attack kind ships without an observability story: the sim
-    registry covers every sim-injectable kind, and the wire registry
-    (a superset: the socket boundary can inject everything plus
-    resets, signature corruption and crashes) covers the full
-    taxonomy."""
+    registry covers every sim-injectable kind, the wire registry adds
+    the socket-boundary kinds (resets, signature corruption, crashes),
+    and the process-tier registry (net/cluster.py — a real OS process
+    per validator, so the supervisor additionally owns each child's
+    clock environment) covers the full taxonomy."""
     from hydrabadger_tpu.net.chaos import WIRE_FAULT_OBSERVABLES
+    from hydrabadger_tpu.net.cluster import PROC_FAULT_OBSERVABLES
 
     wire_only = {T.BYZ_LINK_RESET, T.BYZ_SIG_CORRUPT, T.BYZ_CRASH}
-    assert set(FAULT_OBSERVABLES) == set(T.BYZ_KINDS) - wire_only
-    assert set(WIRE_FAULT_OBSERVABLES) == set(T.BYZ_KINDS)
+    proc_only = {T.BYZ_CLOCK_SKEW}
+    assert set(FAULT_OBSERVABLES) == set(T.BYZ_KINDS) - wire_only - proc_only
+    assert set(WIRE_FAULT_OBSERVABLES) == set(T.BYZ_KINDS) - proc_only
+    assert set(PROC_FAULT_OBSERVABLES) == set(T.BYZ_KINDS)
 
 
 # -- liveness under attack ---------------------------------------------------

@@ -480,10 +480,18 @@ async def test_crash_restart_fast_forward_recovery():
         ckpt = victim.checkpoint()
         survivors = [n for n in nodes if n is not victim]
         await victim.crash()
-        # the network advances far past the checkpoint epoch
-        target = max(n.current_epoch for n in survivors) + 5
+        # the network advances far past the checkpoint epoch — and past
+        # HB's MAX_FUTURE_EPOCHS window: within the window a restarted
+        # node can legitimately catch the in-flight epoch straight from
+        # the peers' welcome-back replay (no fast-forward needed), so a
+        # smaller gap makes this assertion a RACE between two healthy
+        # recovery flows instead of a pin on the fast-forward one
+        from hydrabadger_tpu.consensus.honey_badger import MAX_FUTURE_EPOCHS
+
+        target = max(n.current_epoch for n in survivors) + MAX_FUTURE_EPOCHS + 2
         assert await wait_for(
-            lambda: min(n.current_epoch for n in survivors) >= target
+            lambda: min(n.current_epoch for n in survivors) >= target,
+            timeout=45,
         ), "survivors stalled while victim was down"
         restarted = Hydrabadger.from_checkpoint(
             InAddr("127.0.0.1", base + 1), ckpt, cfg, seed=999

@@ -1090,6 +1090,114 @@ def _wire_chaos_config12(epochs: int = 10) -> dict:
     }
 
 
+def _rbc_bytes_config14(epochs_16: int = 4, epochs_64: int = 2) -> dict:
+    """Round-13 bandwidth row (ROADMAP item 2): bytes/epoch as a
+    first-class metric, captured for BOTH reliable-broadcast variants
+    at 16 and 64 nodes on the metered message plane.
+
+    Per topology the two legs run the SAME seed/workload and the row
+    asserts (a) committed batches are point-identical across variants —
+    the knob changes wire shape, never agreement — and (b) the low-comm
+    variant (arxiv 2404.08070: bare shards under a homomorphic-sketch
+    commitment instead of per-shard Merkle branches) cuts bytes/epoch
+    by >= 30% at 64 nodes, where the 224-byte branch per echo is the
+    O(n^2) wall.  A homhash micro-leg additionally pins the device fold
+    (ops/homhash_jax, one MXU bit-matmul dispatch) bit-identical to the
+    host twin and records its lane occupancy."""
+    import hashlib as _hashlib
+
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+    from hydrabadger_tpu.utils.envflags import resolve_rbc_variant
+
+    def leg(n_nodes: int, epochs: int, variant: str) -> tuple:
+        net = SimNetwork(
+            SimConfig(
+                n_nodes=n_nodes,
+                protocol="qhb",
+                epochs=epochs,
+                seed=5,
+                rbc_variant=variant,
+                meter_bytes=True,
+                native_acs=False,
+            )
+        )
+        m = net.run()
+        assert m.agreement_ok, f"config14 {n_nodes}/{variant}: agreement"
+        assert m.epochs_done >= epochs, f"config14 {n_nodes}/{variant}: under-ran"
+        digest = _hashlib.sha256()
+        for b in net._batches(net.ids[0]):
+            for p, ts in sorted(b.contributions.items()):
+                digest.update(repr(p).encode())
+                for t in ts:
+                    digest.update(bytes(t))
+        net.shutdown()
+        return m, digest.hexdigest()
+
+    rows = {}
+    reductions = {}
+    for n_nodes, epochs in ((16, epochs_16), (64, epochs_64)):
+        per_variant = {}
+        digests = {}
+        for variant in ("bracha", "lowcomm"):
+            m, digest = leg(n_nodes, epochs, variant)
+            per_variant[variant] = {
+                "bytes_per_epoch": round(m.bytes_per_epoch),
+                "bytes_tx_total": m.bytes_tx_total,
+                "bytes_rx_total": m.bytes_rx_total,
+                "epochs_per_sec": round(m.epochs_per_sec, 3),
+                "msgs_per_epoch": round(m.msgs_per_epoch, 1),
+                "epochs": m.epochs_done,
+            }
+            digests[variant] = digest
+        assert digests["bracha"] == digests["lowcomm"], (
+            f"config14 {n_nodes}-node: committed batches diverged "
+            "across RBC variants"
+        )
+        red = 1 - (
+            per_variant["lowcomm"]["bytes_per_epoch"]
+            / per_variant["bracha"]["bytes_per_epoch"]
+        )
+        reductions[n_nodes] = round(red, 4)
+        rows[f"{n_nodes}node"] = per_variant
+    assert reductions[64] >= 0.30, (
+        f"config14: low-comm RBC cut only {reductions[64]:.1%} of "
+        "bytes/epoch at 64 nodes (< 30% target)"
+    )
+    # homhash micro-leg: device fold vs host twin, one dispatch
+    from hydrabadger_tpu.crypto import homhash as _hh
+    from hydrabadger_tpu.obs.metrics import default_registry
+    from hydrabadger_tpu.ops import homhash_jax
+
+    rng = np.random.default_rng(7)
+    shards = rng.integers(0, 256, size=(64, 256), dtype=np.uint8)
+    host = _hh.sketch_batch_np(shards, b"config14")
+    t0 = time.perf_counter()
+    dev = homhash_jax.sketch_batch(shards, b"config14")
+    homhash_wall = time.perf_counter() - t0
+    assert np.array_equal(host, dev), "config14: homhash device != host"
+    occupancy = default_registry().gauge("homhash_lane_occupancy").value
+    return {
+        "metric": "rbc_bytes_per_epoch_reduction_64node",
+        "value": reductions[64],
+        "unit": "fraction of bracha bytes/epoch saved by lowcomm",
+        "reduction_16node": reductions[16],
+        "rbc_variant_default": resolve_rbc_variant(None),
+        "legs": rows,
+        "batches_point_identical": True,
+        "homhash": {
+            "device_matches_host": True,
+            "lane_occupancy": occupancy,
+            "sketches_per_sec": round(64 / homhash_wall),
+        },
+        "note": (
+            "metered sim message plane (codec wire size per frame); "
+            "lowcomm echoes carry (32B commitment + shard) instead of "
+            "(shard + Merkle branch + root); identical committed "
+            "batches pinned by digest across variants at both sizes"
+        ),
+    }
+
+
 def _process_chaos_config13(epochs: int = 3) -> dict:
     """Round-10 process-tier chaos row: the robustness twin of config 12
     one layer further down — every validator is a REAL OS process
@@ -1143,7 +1251,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13],
+        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
@@ -1160,7 +1268,18 @@ def main(argv=None) -> int:
         "f=1 Byzantine peer + link faults + crash/restart; commit gap "
         "and recovery catch-up time), 13 = process-tier chaos (4 real "
         "OS processes, real SIGKILL + disk-checkpoint restart; commit "
-        "gap and recovery catch-up under a genuine process death)",
+        "gap and recovery catch-up under a genuine process death), "
+        "14 = RBC bandwidth row (bytes/epoch + epochs/s for the bracha "
+        "and low-comm broadcast variants at 16/64 nodes on the metered "
+        "message plane; committed batches pinned point-identical)",
+    )
+    p.add_argument(
+        "--rbc",
+        choices=["bracha", "lowcomm"],
+        default=None,
+        help="force the reliable-broadcast variant for THIS bench "
+        "process (sets HYDRABADGER_RBC; e.g. re-run the --config 12 "
+        "wire-chaos scenario with the low-comm RBC selected)",
     )
     p.add_argument(
         "--epochs",
@@ -1188,6 +1307,12 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.epochs is not None and args.epochs < 1:
         p.error("--epochs must be >= 1")
+    if args.rbc is not None:
+        # process-scoped by design: bench is a one-shot CLI, and every
+        # node/sim this process spawns (incl. --config 12's chaos
+        # cluster and --config 13's child processes, which inherit the
+        # environment) must speak one broadcast dialect
+        os.environ["HYDRABADGER_RBC"] = args.rbc
 
     def epochs_or(default: int) -> int:
         return default if args.epochs is None else args.epochs
@@ -1256,6 +1381,10 @@ def main(argv=None) -> int:
             # way (the children pin JAX_PLATFORMS=cpu by design)
             ("config13_process_chaos",
              lambda: _process_chaos_config13(epochs_or(3)), "always"),
+            ("config14_rbc_bytes",
+             lambda: _rbc_bytes_config14(
+                 epochs_or(4), max(1, epochs_or(4) // 2)
+             ), "always"),
         ]
         jax_ok = not probe.get("error")
         backend_lost = False
@@ -1388,6 +1517,12 @@ def main(argv=None) -> int:
         return single(lambda: _wire_chaos_config12(epochs_or(10)))
     if args.config == 13:
         return single(lambda: _process_chaos_config13(epochs_or(3)))
+    if args.config == 14:
+        return single(
+            lambda: _rbc_bytes_config14(
+                epochs_or(4), max(1, epochs_or(4) // 2)
+            )
+        )
 
     # config 3 (also the fall-through for the bare invocation)
     return single(_rs_throughput_config3)

@@ -106,6 +106,13 @@ def make_parser() -> argparse.ArgumentParser:
         help="CryptoEngine backend (north star: engine off the Config)",
     )
     p.add_argument(
+        "--rbc",
+        choices=["bracha", "lowcomm"],
+        default=None,
+        help="reliable-broadcast variant (default: HYDRABADGER_RBC or "
+        "bracha; consensus/broadcast.py)",
+    )
+    p.add_argument(
         "--fast-crypto",
         action="store_true",
         help="development tier: hash coin, no threshold encryption, "
@@ -211,6 +218,7 @@ def main(argv=None) -> int:
         output_extra_delay_ms=args.output_extra_delay,
         start_epoch=args.start_epoch,
         engine=args.engine,
+        rbc_variant=args.rbc,
         checkpoint_path=args.checkpoint,
         checkpoint_every=max(1, args.checkpoint_every),
     )

@@ -224,6 +224,7 @@ class NodeCheckpoint:
         rng=None,
         engine=None,
         recorder=None,
+        rbc_variant=None,
     ) -> DynamicHoneyBadger:
         """Rebuild the consensus core at the saved era/epoch.
 
@@ -252,6 +253,7 @@ class NodeCheckpoint:
             rng=rng,
             engine=engine,
             recorder=recorder,
+            rbc_variant=rbc_variant,
         )
         dhb.hb.epoch = self.epoch - self.era
         return dhb

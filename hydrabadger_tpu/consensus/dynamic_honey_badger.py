@@ -171,6 +171,7 @@ class DynamicHoneyBadger:
         rng=None,
         engine=None,
         recorder=None,
+        rbc_variant=None,
     ):
         self.our_id = our_id
         self.our_sk = our_sk
@@ -182,6 +183,7 @@ class DynamicHoneyBadger:
         self.encrypt = encrypt
         self.coin_mode = coin_mode
         self.verify_shares = verify_shares
+        self.rbc_variant = rbc_variant
         self.engine = engine
         self.rng = rng
         self.obs = _resolve_recorder(recorder)
@@ -224,6 +226,8 @@ class DynamicHoneyBadger:
             # getattr: pre-obs pickled snapshots resume through here
             recorder=getattr(self, "obs", None)
             and self.obs.bind(era=self.era),
+            # getattr: pre-round-13 snapshots predate the variant knob
+            rbc_variant=getattr(self, "rbc_variant", None),
         )
 
     @classmethod
@@ -239,6 +243,7 @@ class DynamicHoneyBadger:
         engine=None,
         recorder=None,
         sk_share=None,
+        rbc_variant=None,
     ) -> "DynamicHoneyBadger":
         """Instantiate as an observer from a committed JoinPlan
         (the reference's `new_joining` path, state.rs:200-250).
@@ -268,6 +273,7 @@ class DynamicHoneyBadger:
             rng=rng,
             engine=engine,
             recorder=recorder,
+            rbc_variant=rbc_variant,
         )
         dhb.hb.epoch = plan.epoch - plan.era  # skip the era's earlier epochs
         return dhb

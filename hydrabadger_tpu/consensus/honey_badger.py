@@ -60,12 +60,16 @@ class HoneyBadger:
         start_epoch: int = 0,
         engine=None,
         recorder=None,
+        rbc_variant=None,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
         self.encrypt = encrypt
         self.coin_mode = coin_mode
         self.verify_shares = verify_shares
+        # RBC variant for every broadcast instance this badger spawns
+        # (consensus/broadcast.py VARIANTS; None = "bracha")
+        self.rbc_variant = rbc_variant
         self.engine = get_engine(engine)
         self.obs = _resolve_recorder(recorder)
         self.epoch = start_epoch
@@ -164,6 +168,8 @@ class HoneyBadger:
                     verify_coin_shares=self.verify_shares,
                     engine=self.engine,
                     recorder=eobs,
+                    # getattr: pre-round-13 pickled snapshots lack it
+                    rbc_variant=getattr(self, "rbc_variant", None),
                 ),
                 obs=eobs,
             )

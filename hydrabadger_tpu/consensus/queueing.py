@@ -31,6 +31,7 @@ class QueueingHoneyBadger:
         auto_propose: bool = True,
         engine=None,
         recorder=None,
+        rbc_variant=None,
     ):
         self.netinfo = netinfo
         self.batch_size = max(1, batch_size)
@@ -45,6 +46,7 @@ class QueueingHoneyBadger:
             verify_shares=verify_shares,
             engine=engine,
             recorder=recorder,
+            rbc_variant=rbc_variant,
         )
         self.batches: List[Batch] = []
 

@@ -34,6 +34,7 @@ class Subset:
         verify_coin_shares: bool = True,
         engine=None,
         recorder=None,
+        rbc_variant=None,
     ):
         self.netinfo = netinfo
         self.session_id = bytes(session_id)
@@ -48,6 +49,7 @@ class Subset:
                 nid,
                 engine=engine,
                 recorder=self.obs.bind(instance=i),
+                variant=rbc_variant,
             )
             for i, nid in enumerate(netinfo.node_ids)
         }

@@ -115,6 +115,31 @@ class CpuEngine:
             out[b] = np.stack(shards[:data_shards])
         return out
 
+    # -- homomorphic shard sketches (the low-comm RBC verify plane) ---------
+
+    def homhash_batch(
+        self, shards: Sequence[bytes], seed: bytes
+    ) -> List[bytes]:
+        """Sketch equal-length RS shards: one batched GF(2^8) fold over
+        ALL of a Broadcast instance's peers' shards (crypto/homhash) —
+        the low-comm RBC's replacement for per-shard Merkle branch
+        hashing.  CPU = the native SIMD matmul; the TPU engine lifts the
+        same fold onto the MXU bit-matmul plane, pinned bit-identical."""
+        from . import homhash
+
+        return homhash.sketch_shards(list(shards), bytes(seed))
+
+    def submit_homhash_batch(
+        self, shards: Sequence[bytes], seed: bytes
+    ) -> "futures.CryptoFuture":
+        """Future twin (PR-5 hbasync contract): immediate on the host
+        engine, dispatch-now/materialize-later on the device engine."""
+        from . import futures
+
+        return futures.immediate(
+            self.homhash_batch(shards, seed), "homhash_batch"
+        )
+
     # -- per-frame BLS signatures (lib.rs:411,434) --------------------------
 
     def sign(self, sk: th.SecretKey, msg: bytes) -> th.Signature:
@@ -565,6 +590,34 @@ class TpuEngine(CpuEngine):
             surviving, tuple(int(r) for r in rows), data_shards, parity_shards
         )
         return np.asarray(out)
+
+    def homhash_batch(
+        self, shards: Sequence[bytes], seed: bytes
+    ) -> List[bytes]:
+        """All shards' sketches as ONE MXU bit-matmul dispatch
+        (ops/homhash_jax); lane occupancy rides the default registry."""
+        if not shards:
+            return []
+        from ..ops import homhash_jax
+
+        arr = np.stack([np.frombuffer(s, dtype=np.uint8) for s in shards])
+        out = homhash_jax.sketch_batch(arr, bytes(seed))
+        return [out[i].tobytes() for i in range(out.shape[0])]
+
+    def submit_homhash_batch(
+        self, shards: Sequence[bytes], seed: bytes
+    ) -> "futures.CryptoFuture":
+        from . import futures
+
+        if not shards:
+            return futures.immediate([], "homhash_batch")
+        from ..ops import homhash_jax
+
+        arr = np.stack([np.frombuffer(s, dtype=np.uint8) for s in shards])
+        fin = homhash_jax.sketch_batch_submit(arr, bytes(seed))
+        return futures.submit(
+            lambda: [row.tobytes() for row in fin()], "homhash_batch"
+        )
 
     def decrypt_share_batch(
         self,

@@ -1,0 +1,100 @@
+"""GF(2^8)-linear shard sketches — the homomorphic hash of the RBC plane.
+
+The low-communication Broadcast variant (consensus/broadcast.py,
+PAPERS.md arxiv 2404.08070) drops per-shard Merkle branches from the
+echo flow; what replaces the branch check is a *homomorphic* hash over
+the Reed-Solomon code (PAPERS.md arxiv 2010.04607's coded-shard role):
+
+    sketch(s) = s · M(seed)        M(seed) ∈ GF(2^8)^[L, D]
+
+``M`` is a public matrix derived from ``seed`` in counter mode, so the
+sketch is GF(2^8)-linear in the shard: ``sketch(Σ c_i s_i) =
+Σ c_i sketch(s_i)``.  Linearity is the whole point — every shard of a
+codeword is sketched by the SAME map on the byte axis, so one batched
+GF matmul verifies *all* peers' shards of an epoch at once (host: the
+native SIMD path below; device: ops/homhash_jax rides the MXU
+bit-matmul), where the Merkle path costs one host hash chain per shard.
+
+Security stance (documented, not hand-waved): ``M`` is public, so a
+targeted adversary can construct sketch collisions offline.  The sketch
+is therefore a *filter* — it rejects garbage/corrupted shards before an
+expensive decode with failure probability 2^-64 per random forgery —
+never the safety anchor.  Binding comes from the SHA-256 payload hash
+and the SHA-256 commitment over the full sketch vector that the
+low-comm variant checks after every decode: a shard that beats the
+sketch still cannot make a wrong payload decide (broadcast.py
+re-derives both hashes from the decoded payload).  The Merkle variant
+remains the default and the fallback.
+"""
+from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+from typing import List, Sequence
+
+import numpy as np
+
+from . import _native
+
+# sketch width in GF(2^8) symbols: 8 bytes = 2^-64 random-collision
+# probability, and one uint64 lane per shard in comparisons
+SKETCH_BYTES = 8
+
+_DOMAIN = b"hbtpu-homhash-v1"
+
+
+@lru_cache(maxsize=512)
+def _matrix_T(seed: bytes, length: int) -> np.ndarray:
+    """[SKETCH_BYTES, length] transposed sketch matrix for ``seed``.
+
+    Counter-mode with NOTHING discarded: digest ``c`` of
+    SHA-256(domain || seed || c) supplies rows ``4c .. 4c+3`` of the
+    un-transposed [L, D] matrix (32 digest bytes = 4 rows of D=8), so
+    derivation costs one compression per 4 shard bytes.  The matrix
+    for a LONGER length is a strict extension — chunk digests do not
+    depend on the total length — so padding shards with zero bytes and
+    extending the matrix leaves every sketch unchanged: the property
+    the device twin relies on to bucket the shard-length axis
+    (ops/homhash_jax)."""
+    per = 32 // SKETCH_BYTES  # rows per digest
+    rows = bytearray()
+    for c in range(-(-length // per)):
+        rows += hashlib.sha256(
+            _DOMAIN + seed + c.to_bytes(4, "big")
+        ).digest()
+    m = np.frombuffer(bytes(rows), dtype=np.uint8)[
+        : length * SKETCH_BYTES
+    ].reshape(length, SKETCH_BYTES)
+    out = np.ascontiguousarray(m.T)
+    out.flags.writeable = False
+    return out
+
+
+def matrix_T(seed: bytes, length: int) -> np.ndarray:
+    """Public accessor (host numpy, cached, read-only)."""
+    return _matrix_T(bytes(seed), int(length))
+
+
+def sketch_batch_np(shards: np.ndarray, seed: bytes) -> np.ndarray:
+    """[B, L] uint8 shards -> [B, SKETCH_BYTES] sketches (host path).
+
+    One GF(2^8) matmul through the native SIMD library when built —
+    the CPU twin the device fold (ops/homhash_jax.sketch_batch) is
+    pinned bit-identical against."""
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    if shards.ndim != 2:
+        raise ValueError(f"expected [B, L] shards, got {shards.shape}")
+    if shards.shape[1] == 0:
+        return np.zeros((shards.shape[0], SKETCH_BYTES), dtype=np.uint8)
+    mt = matrix_T(seed, shards.shape[1])  # [D, L]
+    out = _native.gf_matmul(mt, np.ascontiguousarray(shards.T))  # [D, B]
+    return np.ascontiguousarray(out.T)
+
+
+def sketch_shards(shards: Sequence[bytes], seed: bytes) -> List[bytes]:
+    """Equal-length byte shards -> list of SKETCH_BYTES digests."""
+    if not shards:
+        return []
+    arr = np.stack([np.frombuffer(s, dtype=np.uint8) for s in shards])
+    out = sketch_batch_np(arr, seed)
+    return [out[i].tobytes() for i in range(out.shape[0])]

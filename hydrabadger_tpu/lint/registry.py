@@ -298,7 +298,10 @@ CONFIG_BOUNDED_JIT = {
         "survivor-row pattern folds into dbits data; data_shards static"
     ),
     "ops/gf256_jax.py::_bits_matmul": (
-        "GF(2^8) bit-matmul operand shapes fixed per (n, tile) config"
+        "GF(2^8) bit-matmul operand shapes fixed per (n, tile) config; "
+        "the homhash caller (ops/homhash_jax) additionally buckets both "
+        "of its dynamic dims (shard length, batch) through the shared "
+        "_bucket ladder"
     ),
     "ops/gf256_jax.py::_gf_matmul_pallas": (
         "tile_l is a static_argname; operand shapes per config"
@@ -348,6 +351,12 @@ ENV_FLAGS = {
     "HYDRABADGER_SHADOW_STALL_EPOCHS": (
         "epochs without committed DKG progress before the stall fault "
         "fires (default 8; consensus/dynamic_honey_badger)"
+    ),
+    "HYDRABADGER_RBC": (
+        "reliable-broadcast variant default: bracha (Merkle branches, "
+        "the reference protocol) or lowcomm (reduced-communication RBC "
+        "with homomorphic-sketch commitments, round 13); explicit "
+        "SimConfig/Config values win (utils/envflags)"
     ),
     "HYDRABADGER_NTT": (
         "0 pins the reference polynomial paths everywhere (NTT plane "

@@ -170,7 +170,52 @@ def sample_messages(wire_module=None):
             f"wire samples drifted: missing={sorted(missing)} "
             f"extra={sorted(extra)} — update lint/wire_contract.py"
         )
-    return [wire_module.WireMessage(k, samples[k]) for k in sorted(declared)]
+    out = [wire_module.WireMessage(k, samples[k]) for k in sorted(declared)]
+    # RBC leaf variants ride INSIDE the "message" kind, so kind-level
+    # coverage alone would never round-trip their payload shapes; one
+    # enveloped sample per leaf keeps every broadcast dialect (Merkle
+    # bracha AND the round-13 low-comm variant) in the decode pin and
+    # the malformed-truncation corpus below
+    out.extend(rbc_leaf_samples(wire_module))
+    return out
+
+
+def rbc_leaf_samples(wire_module=None):
+    """One codec-round-trippable ``"message"`` envelope per RBC leaf
+    kind (consensus/broadcast.py), both variants.  Raises on drift from
+    the broadcast module's declared kinds, mirroring sample_messages'
+    contract with wire.KINDS."""
+    if wire_module is None:
+        from ..net import wire as wire_module
+    from ..consensus import broadcast as bc
+
+    uid = b"\x42" * 16
+    proof_wire = (b"shard-bytes", 1, (b"\x01" * 32, b"\x02" * 32), b"\x03" * 32)
+    leaves = {
+        bc.MSG_VALUE: proof_wire,
+        bc.MSG_ECHO: proof_wire,
+        bc.MSG_READY: b"\x03" * 32,
+        bc.MSG_VALUE_LC: (b"\x04" * 32, b"\x05" * 32, b"shard-bytes"),
+        bc.MSG_ECHO_LC: (b"\x06" * 32, b"shard-bytes"),
+        bc.MSG_READY_LC: b"\x06" * 32,
+    }
+    declared = {
+        v
+        for k, v in vars(bc).items()
+        if k.startswith("MSG_") and isinstance(v, str)
+    }
+    if declared != set(leaves):
+        raise AssertionError(
+            f"RBC leaf samples drifted: missing={sorted(declared - set(leaves))} "
+            f"extra={sorted(set(leaves) - declared)} — update "
+            "lint/wire_contract.rbc_leaf_samples"
+        )
+    return [
+        wire_module.WireMessage(
+            "message", (uid, ("hb", 0, ("cs", 1, (kind, leaves[kind]))))
+        )
+        for kind in sorted(leaves)
+    ]
 
 
 def _uvarint(n: int) -> bytes:
@@ -218,6 +263,15 @@ def malformed_samples(wire_module=None):
         ("forged:count_over_frame", b"L" + _uvarint(1 << 32) + real[2:]),
         ("forged:pair_count", b"L" + _uvarint(200) + real[2:]),
     ]
+    # RBC-leaf-targeted forgeries (round 13): counts spliced over the
+    # low-comm echo/value envelopes — the bare-shard bodies are the new
+    # hot decode surface — plus a tuple-arity lie inside the leaf
+    for msg in rbc_leaf_samples(wire_module):
+        raw = msg.encode()
+        out += [
+            ("rbc:forged_count", b"L" + _uvarint(1 << 40) + raw[2:]),
+            ("rbc:count_over_frame", b"L" + _uvarint(240) + raw[2:]),
+        ]
     # kind-level malformations
     out += [
         ("kind:unknown", codec.encode(("no_such_kind", None))),

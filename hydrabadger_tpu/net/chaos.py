@@ -51,7 +51,12 @@ from typing import Dict, List, Optional, Tuple
 
 from ..consensus import types as T
 from ..obs.logging import get_logger
-from ..obs.metrics import BYZ_FAULTS_PREFIX, MetricsRegistry
+from ..obs.metrics import (
+    BYTES_RX_TOTAL,
+    BYTES_TX_TOTAL,
+    BYZ_FAULTS_PREFIX,
+    MetricsRegistry,
+)
 from ..sim.scenario import (
     FAULT_OBSERVABLES,
     InjectionLog,
@@ -315,9 +320,17 @@ class ChaosWireStream(WireStream):
         self.plane = plane
         self.local_uid = local_uid
 
+    def _count_tx(self, frame: bytes) -> None:
+        """Bandwidth accounting for the fault paths that bypass
+        WireStream.send (delayed releases, corrupted frames,
+        duplicates): injected traffic is wire traffic too."""
+        if self.metrics is not None:
+            self.metrics.counter(BYTES_TX_TOTAL).inc(len(frame))
+
     async def _send_after(self, delay_s: float, frame: bytes, lost_kind: str) -> None:
         try:
             await asyncio.sleep(delay_s)
+            self._count_tx(frame)
             self.writer.write(frame)
             await self.writer.drain()
         except (ConnectionError, OSError, RuntimeError):
@@ -380,10 +393,12 @@ class ChaosWireStream(WireStream):
             # behind this one waits — a congested/choked link, not
             # reordering (that is what delay models)
             await asyncio.sleep(pol.stall_s)
+        self._count_tx(frame)
         self.writer.write(frame)
         await self.writer.drain()
         if pol.duplicate and rng.random() < pol.duplicate:
             plane.log.note(T.BYZ_LINK_DUP)
+            self._count_tx(frame)
             self.writer.write(frame)
             await self.writer.drain()
 
@@ -801,6 +816,16 @@ async def chaos_cluster(
             "recovery_catchup_s": (
                 round(recovery_catchup_s, 2)
                 if recovery_catchup_s is not None
+                else None
+            ),
+            # bandwidth (round 13): framed bytes across every
+            # incarnation's WireStreams, and per committed epoch — the
+            # real-socket sibling of the sim's metered-router figure
+            "bytes_tx_total": snap.get(BYTES_TX_TOTAL, 0),
+            "bytes_rx_total": snap.get(BYTES_RX_TOTAL, 0),
+            "bytes_per_epoch": (
+                round(snap.get(BYTES_TX_TOTAL, 0) / committed)
+                if committed
                 else None
             ),
             "byz_injected": dict(plane.log.counts),

@@ -164,6 +164,12 @@ class Config:
     wire_sign: bool = True  # BLS-sign/verify every frame (lib.rs:429-447)
     # CryptoEngine backend name — see the class docstring
     engine: str = "cpu"
+    # reliable-broadcast variant (consensus/broadcast.py VARIANTS):
+    # None resolves via HYDRABADGER_RBC, default "bracha"; "lowcomm"
+    # selects the reduced-communication RBC (ROADMAP item 2).  Resolved
+    # ONCE at node construction and threaded into every consensus-core
+    # build (bootstrap DKG, observer join, checkpoint restore)
+    rbc_variant: Optional[str] = None
     # durable checkpointing (process-tier chaos plane): when set, the
     # node persists an era/epoch-stamped NodeCheckpoint to this path
     # (generational store, checkpoint.CheckpointStore) every
@@ -296,6 +302,15 @@ class Hydrabadger:
         self.uid = uid or Uid()
         self.bind = bind
         self.cfg = config or Config()
+        # RBC variant resolved once (explicit Config value wins over
+        # the HYDRABADGER_RBC ambient default; utils/envflags) so every
+        # core this node ever builds — bootstrap, join, restore,
+        # fast-forward — agrees on the broadcast wire dialect
+        from ..utils.envflags import resolve_rbc_variant
+
+        self.rbc_variant = resolve_rbc_variant(
+            getattr(self.cfg, "rbc_variant", None)
+        )
         # wire-tier chaos plane (net/chaos.ChaosPlane, duck-typed so
         # this module never imports net/chaos): when set, every stream
         # this node opens is wrapped in the plane's fault injector
@@ -530,6 +545,7 @@ class Hydrabadger:
             rng=node.rng,
             engine=node.cfg.engine,
             recorder=node.obs,
+            rbc_variant=node.rbc_variant,
         ))
         node.current_epoch = ckpt.epoch
         node.state = "validator" if ckpt.sk_share else "observer"
@@ -698,11 +714,18 @@ class Hydrabadger:
         socket boundary); ByzantineHydrabadger overrides this to mount
         its signature-corruption plane on top."""
         if self.chaos is not None:
-            return self.chaos.wrap_stream(
+            stream = self.chaos.wrap_stream(
                 reader, writer, self.secret_key, self.cfg.wire_sign,
                 self.uid.bytes,
             )
-        return WireStream(reader, writer, self.secret_key, self.cfg.wire_sign)
+        else:
+            stream = WireStream(
+                reader, writer, self.secret_key, self.cfg.wire_sign
+            )
+        # bandwidth accounting (round 13): framed bytes counted at the
+        # stream, attributed to this node's registry
+        stream.metrics = self.metrics
+        return stream
 
     def _wrap_dhb(self, dhb):
         """Hook: every path that installs a consensus core routes the
@@ -1292,6 +1315,7 @@ class Hydrabadger:
                 engine=self.cfg.engine,
                 recorder=self.obs,
                 sk_share=share,
+                rbc_variant=self.rbc_variant,
             )
         )
         self.state = "validator" if share is not None else "observer"
@@ -1708,6 +1732,7 @@ class Hydrabadger:
                 rng=self.rng,
                 engine=self.cfg.engine,
                 recorder=self.obs,
+                rbc_variant=self.rbc_variant,
             ))
             self.key_gen = None
             # keep the outbox: stragglers behind a healing link still need
@@ -1779,6 +1804,7 @@ class Hydrabadger:
             rng=self.rng,
             engine=self.cfg.engine,
             recorder=self.obs,
+            rbc_variant=self.rbc_variant,
         ))
         # chaos-contract observable: a crash/restart that was voted out
         # and re-added recovers through one (or more) of these adoptions

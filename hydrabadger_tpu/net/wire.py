@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
 from ..crypto.threshold import PublicKey, SecretKey, Signature
+from ..obs.metrics import BYTES_RX_TOTAL, BYTES_TX_TOTAL
 from ..utils import codec
 from ..utils.ids import Uid
 
@@ -118,6 +119,13 @@ class WireStream:
         # per-link fault policies by it, and it is generally useful for
         # attributing a stream to the node behind it
         self.peer_uid: Optional[bytes] = None
+        # bandwidth accounting (round 13): the stream IS the node's
+        # wire boundary, so framed bytes are counted here — every
+        # send/recv, headers included — into the owning node's registry
+        # (obs/metrics BYTES_TX_TOTAL / BYTES_RX_TOTAL).  Wired by the
+        # owner (Hydrabadger._new_stream assigns its registry) — ONE
+        # wiring path, chaos subclass included
+        self.metrics = None
 
     def _frame(self, msg: WireMessage) -> bytes:
         """Sign + length-prefix one message into its on-wire bytes.
@@ -140,7 +148,10 @@ class WireStream:
         # one write() call per frame: concurrent senders (the chaos
         # plane's delayed-release tasks) interleave at frame, never
         # byte, granularity
-        self.writer.write(self._frame(msg))
+        frame = self._frame(msg)
+        if self.metrics is not None:
+            self.metrics.counter(BYTES_TX_TOTAL).inc(len(frame))
+        self.writer.write(frame)
         await self.writer.drain()
 
     async def recv(self) -> Tuple[WireMessage, bytes, bytes]:
@@ -155,6 +166,8 @@ class WireStream:
         if length > MAX_FRAME:
             raise WireError("oversized frame")
         frame = await self.reader.readexactly(length)
+        if self.metrics is not None:
+            self.metrics.counter(BYTES_RX_TOTAL).inc(4 + length)
         body, sig_bytes = codec.decode(frame)
         msg = WireMessage.decode(bytes(body))
         return msg, bytes(body), bytes(sig_bytes)

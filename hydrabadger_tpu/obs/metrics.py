@@ -121,6 +121,20 @@ CHECKPOINT_GENERATION_FALLBACKS = "checkpoint_generation_fallbacks"
 CHECKPOINT_PERSIST_FAILURES = "checkpoint_persist_failures"
 CHECKPOINT_PERSISTS_SKIPPED = "checkpoint_persists_skipped"
 
+# Bandwidth plane (round 13, ROADMAP item 2): bytes on the wire as a
+# first-class metric, counted at BOTH transport tiers' chokepoints —
+# the sim router (canonical codec size per send/delivery, opt-in via
+# SimConfig.meter_bytes) and the real WireStream (actual framed bytes,
+# always on).  One spelling here so bench config 14, SOAK rows and the
+# rbc test-all gate all read the same counters:
+#
+#   BYTES_TX_TOTAL / BYTES_RX_TOTAL — cumulative bytes sent/received.
+#   BYTES_PER_EPOCH — gauge: tx bytes divided by committed epochs, the
+#       headline cost figure the low-comm RBC variant is measured by.
+BYTES_TX_TOTAL = "bytes_tx_total"
+BYTES_RX_TOTAL = "bytes_rx_total"
+BYTES_PER_EPOCH = "bytes_per_epoch"
+
 WIRE_SIG_REJECTED = "wire_sig_rejected"
 WIRE_FRONTIER_REJECTED = "wire_frontier_rejected"
 WIRE_SRC_SPOOF = "wire_src_spoof"

@@ -1,0 +1,82 @@
+"""Batched homomorphic shard sketches on device — the RBC verify fold.
+
+Device twin of crypto/homhash.sketch_batch_np: one GF(2^8) matmul
+through the MXU bit-matmul plane (ops/gf256_jax) sketches a whole
+epoch's worth of Reed-Solomon shards in a single dispatch, replacing n
+per-shard host Merkle hash chains with one batched fold (the
+"batch-the-crypto-heavy-inner-loop" north star applied to Broadcast's
+verify path; PAPERS.md arxiv 2010.04607).
+
+Shapes are bucketed on BOTH dynamic axes — shard length L and batch B —
+through the shared ``_bucket`` ladder, so varying payload sizes and
+peer counts reuse a handful of compiled ``_bits_matmul`` signatures.
+Zero-padding is exact: crypto/homhash's matrix rows are generated in
+counter mode, so the padded positions multiply zero bytes and every
+sketch is bit-identical to the host twin (pinned in tests/test_homhash).
+
+Lane accounting mirrors the MSM plane: ``homhash_real_lanes`` /
+``homhash_pad_lanes`` counters plus a ``homhash_lane_occupancy`` gauge
+in the default registry, so bench/soak rows can show how full the fold
+ran.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from ..crypto import homhash
+from ..obs.metrics import default_registry
+from . import gf256_jax
+from .bls_jax import _bucket
+
+
+def _reg():
+    return default_registry()
+
+
+def _note_lanes(real: int, total: int) -> None:
+    _reg().counter("homhash_real_lanes").inc(real)
+    _reg().counter("homhash_pad_lanes").inc(max(0, total - real))
+    if total:
+        _reg().gauge("homhash_lane_occupancy").track(
+            round(real / total, 4)
+        )
+
+
+def _dispatch(shards: np.ndarray, seed: bytes):
+    """Pad + dispatch; returns (device_result [D, Bp], b)."""
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    if shards.ndim != 2:
+        raise ValueError(f"expected [B, L] shards, got {shards.shape}")
+    b, length = shards.shape
+    bp = _bucket(b)
+    lp = _bucket(max(length, 1))
+    _note_lanes(b, bp)
+    data = np.zeros((lp, bp), dtype=np.uint8)
+    data[:length, :b] = shards.T
+    # counter-mode matrix: the [D, Lp] extension of the host twin's
+    # [D, L] matrix — padded rows hit zero bytes, sketches unchanged
+    mt = homhash.matrix_T(seed, lp)
+    return gf256_jax.gf_matmul_bits(np.asarray(mt), data), b
+
+
+def sketch_batch(shards: np.ndarray, seed: bytes) -> np.ndarray:
+    """[B, L] uint8 -> [B, SKETCH_BYTES]; one device dispatch."""
+    if shards.shape[0] == 0:
+        return np.zeros((0, homhash.SKETCH_BYTES), dtype=np.uint8)
+    out, b = _dispatch(shards, seed)
+    return np.ascontiguousarray(np.asarray(out)[:, :b].T)
+
+
+def sketch_batch_submit(
+    shards: np.ndarray, seed: bytes
+) -> Callable[[], np.ndarray]:
+    """hbasync split: dispatch NOW, defer only the host materialization
+    (the PR-5 submit contract — crypto/engine.TpuEngine wraps the
+    returned finisher in a CryptoFuture)."""
+    if shards.shape[0] == 0:
+        empty = np.zeros((0, homhash.SKETCH_BYTES), dtype=np.uint8)
+        return lambda: empty
+    out, b = _dispatch(shards, seed)
+    return lambda: np.ascontiguousarray(np.asarray(out)[:, :b].T)

@@ -86,6 +86,18 @@ def main(argv=None) -> int:
         "process-wide retrace/lane counters) as JSON on exit",
     )
     p.add_argument(
+        "--rbc", choices=["bracha", "lowcomm"], default=None,
+        help="reliable-broadcast variant (default: HYDRABADGER_RBC or "
+        "bracha); lowcomm = reduced-communication RBC with homomorphic-"
+        "sketch commitments (ROADMAP item 2)",
+    )
+    p.add_argument(
+        "--meter-bytes", action="store_true",
+        help="price every router send/delivery at its codec wire size "
+        "(bytes_tx_total / bytes_rx_total / bytes_per_epoch in the "
+        "metrics; disables the native ACS fast path)",
+    )
+    p.add_argument(
         "--checkpoint", default=None, metavar="PATH",
         help="write a full-state sim checkpoint when the run finishes",
     )
@@ -208,6 +220,8 @@ def main(argv=None) -> int:
             adversary=adversary,
             scenario=scenario,
             trace=bool(args.trace),
+            rbc_variant=args.rbc,
+            meter_bytes=args.meter_bytes,
         )
         net = SimNetwork(cfg)
 

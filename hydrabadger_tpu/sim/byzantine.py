@@ -35,7 +35,13 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from ..consensus import types as T
-from ..consensus.broadcast import MSG_ECHO, MSG_VALUE
+from ..consensus.broadcast import (
+    MSG_ECHO,
+    MSG_ECHO_LC,
+    MSG_VALUE,
+    MSG_VALUE_LC,
+    lc_commitment,
+)
 from ..consensus.merkle import MerkleTree, Proof
 from ..consensus.threshold_decrypt import MSG_DEC_SHARE
 from ..consensus.types import Step, Target, TargetedMessage
@@ -96,13 +102,25 @@ class Strategy:
 class EquivocateRbc(Strategy):
     """Split-root broadcast: peers at even indexes get shards/echoes of
     the real coding, peers at odd indexes get a second, conflicting
-    coding — disjoint peer sets, two Merkle roots, one instance."""
+    coding — disjoint peer sets, two roots, one instance.  Attacks BOTH
+    RBC dialects: the Merkle variant (two trees) and the low-comm
+    variant (two sketch commitments — the adversary model of arxiv
+    2404.08070; the mixed-commitment detector must fire identically)."""
 
     kind = T.BYZ_EQUIVOCATION
 
     def __init__(self, rng, log):
         super().__init__(rng, log)
         self._alt: Dict[bytes, MerkleTree] = {}  # real root -> alt tree
+        self._alt_lc: Dict[bytes, tuple] = {}  # commitment -> lc artifacts
+
+    def _alt_payload_shards(self, node: "ByzantineNode", root: bytes):
+        netinfo = node.netinfo
+        n, f = netinfo.num_nodes, netinfo.num_faulty
+        payload = hashlib.sha256(b"byz-equivocation" + root).digest() * 4
+        return payload, node.hb.engine.rs_encode_bytes(
+            payload, n - 2 * f, 2 * f
+        )
 
     def _alt_tree(self, node: "ByzantineNode", root: bytes) -> MerkleTree:
         tree = self._alt.get(root)
@@ -110,13 +128,49 @@ class EquivocateRbc(Strategy):
             return tree
         if len(self._alt) > 64:
             self._alt.clear()  # bounded: one live instance per epoch
-        netinfo = node.netinfo
-        n, f = netinfo.num_nodes, netinfo.num_faulty
-        payload = hashlib.sha256(b"byz-equivocation" + root).digest() * 4
-        shards = node.hb.engine.rs_encode_bytes(payload, n - 2 * f, 2 * f)
+        _payload, shards = self._alt_payload_shards(node, root)
         tree = MerkleTree(shards)
         self._alt[root] = tree
         return tree
+
+    def _alt_coding_lc(self, node: "ByzantineNode", commitment: bytes):
+        """(ph2, vec2, commitment2, shards2): a SELF-CONSISTENT second
+        coding — every forged shard matches its own sketch vector, so
+        only the cross-commitment detector can catch it."""
+        art = self._alt_lc.get(commitment)
+        if art is not None:
+            return art
+        if len(self._alt_lc) > 64:
+            self._alt_lc.clear()
+        netinfo = node.netinfo
+        n, f = netinfo.num_nodes, netinfo.num_faulty
+        payload, shards = self._alt_payload_shards(node, commitment)
+        ph2 = hashlib.sha256(payload).digest()
+        vec2 = b"".join(node.hb.engine.homhash_batch(shards, ph2))
+        commitment2 = lc_commitment(ph2, vec2, n, n - 2 * f)
+        art = (ph2, vec2, commitment2, shards)
+        self._alt_lc[commitment] = art
+        return art
+
+    def _forged_leaf(self, node, leaf, r_idx: int):
+        """The odd-half replacement for one RBC leaf, both dialects."""
+        netinfo = node.netinfo
+        n, f = netinfo.num_nodes, netinfo.num_faulty
+        if leaf[0] in (MSG_VALUE, MSG_ECHO):
+            proof = Proof.from_wire(leaf[1])
+            alt = self._alt_tree(node, proof.root).proof(proof.index)
+            return (leaf[0], alt.wire()) + tuple(leaf[2:])
+        if leaf[0] == MSG_VALUE_LC:
+            ph, vec, _shard = leaf[1]
+            real = lc_commitment(bytes(ph), bytes(vec), n, n - 2 * f)
+            ph2, vec2, _c2, shards2 = self._alt_coding_lc(node, real)
+            # a Value carries the RECIPIENT's shard
+            return (leaf[0], (ph2, vec2, shards2[r_idx]))
+        # MSG_ECHO_LC: our echo carries OUR shard under the commitment
+        our_idx = netinfo.index(netinfo.our_id)
+        real = bytes(leaf[1][0])
+        _ph2, _vec2, c2, shards2 = self._alt_coding_lc(node, real)
+        return (leaf[0], (c2, shards2[our_idx]))
 
     def mutate_step(self, node: "ByzantineNode", step: Step) -> Step:
         netinfo = node.netinfo
@@ -126,7 +180,12 @@ class EquivocateRbc(Strategy):
             leaf_seen: List[tuple] = []
 
             def probe(leaf, pidx):
-                if pidx == our_idx and leaf[0] in (MSG_VALUE, MSG_ECHO):
+                if pidx == our_idx and leaf[0] in (
+                    MSG_VALUE,
+                    MSG_ECHO,
+                    MSG_VALUE_LC,
+                    MSG_ECHO_LC,
+                ):
                     leaf_seen.append(leaf)
                 return None
 
@@ -134,8 +193,6 @@ class EquivocateRbc(Strategy):
             if not leaf_seen:
                 out.append(tm)
                 continue
-            leaf = leaf_seen[0]
-            proof = Proof.from_wire(leaf[1])
             forged = 0
             for rid in netinfo.node_ids:
                 if rid == netinfo.our_id or not tm.target.includes(rid):
@@ -144,16 +201,13 @@ class EquivocateRbc(Strategy):
                 if r_idx % 2 == 0:
                     out.append(TargetedMessage(Target.node(rid), tm.message))
                     continue
-                # odd half: same leaf kind, conflicting coding.  A Value
-                # carries the recipient's shard; our Echo carries OUR
-                # shard — both swap to the alt tree's proof at the same
-                # index.
-                alt = self._alt_tree(node, proof.root).proof(proof.index)
+                # odd half: same leaf kind, conflicting coding
+                alt_leaf = self._forged_leaf(node, leaf_seen[0], r_idx)
 
                 def swap(lf, pidx):
                     if lf is not leaf_seen[0]:
                         return None
-                    return (lf[0], alt.wire()) + tuple(lf[2:])
+                    return alt_leaf
 
                 out.append(
                     TargetedMessage(

@@ -87,6 +87,19 @@ class SimConfig:
     # SimNetwork.recorder; the router stamps them at each delivery.
     # Off by default — the null recorder keeps the hooks ~free.
     trace: bool = False
+    # reliable-broadcast variant (consensus/broadcast.py VARIANTS):
+    # None = resolve via HYDRABADGER_RBC, default "bracha".  "lowcomm"
+    # selects the reduced-communication RBC (echoes carry bare shards
+    # under a homomorphic-sketch commitment instead of Merkle branches;
+    # ROADMAP item 2).  Committed batches are pinned point-identical
+    # across variants (tests/test_rbc_lowcomm.py, bench config 14).
+    rbc_variant: Optional[str] = None
+    # bandwidth metering (sim/router.py): price every router send and
+    # delivery at its canonical codec size, surfacing bytes_tx_total /
+    # bytes_rx_total / bytes_per_epoch.  Off by default — the encode
+    # costs wall on the hot path; bench config 14 and the rbc soak
+    # gate turn it on.
+    meter_bytes: bool = False
 
 
 @contextmanager
@@ -122,6 +135,9 @@ class SimMetrics:
     bytes_committed: int = 0
     agreement_ok: bool = True
     faults: int = 0
+    # bandwidth (router-metered; zero unless SimConfig.meter_bytes)
+    bytes_tx_total: int = 0
+    bytes_rx_total: int = 0
     # per-epoch wall-time percentiles, ms (SURVEY.md §5.5: batch latency
     # as a first-class sim output; the reference only logs)
     latency_p50_ms: float = 0.0
@@ -142,6 +158,12 @@ class SimMetrics:
     def txns_per_sec(self) -> float:
         return self.txns_committed / self.wall_s if self.wall_s else 0.0
 
+    @property
+    def bytes_per_epoch(self) -> float:
+        return (
+            self.bytes_tx_total / self.epochs_done if self.epochs_done else 0.0
+        )
+
     def as_dict(self) -> dict:
         return {
             "epochs_done": self.epochs_done,
@@ -154,6 +176,9 @@ class SimMetrics:
             "bytes_committed": self.bytes_committed,
             "agreement_ok": self.agreement_ok,
             "faults": self.faults,
+            "bytes_tx_total": self.bytes_tx_total,
+            "bytes_rx_total": self.bytes_rx_total,
+            "bytes_per_epoch": round(self.bytes_per_epoch, 1),
             "latency_p50_ms": round(self.latency_p50_ms, 3),
             "latency_p90_ms": round(self.latency_p90_ms, 3),
             "latency_p99_ms": round(self.latency_p99_ms, 3),
@@ -183,6 +208,13 @@ class SimNetwork:
         )
         self.rng = random.Random(cfg.seed + 1)
         engine = get_engine(cfg.engine)
+        # sans-io cores take the RESOLVED variant; the env default
+        # (HYDRABADGER_RBC) is an I/O-layer concern (utils.envflags)
+        from ..utils.envflags import resolve_rbc_variant
+
+        self.rbc_variant = resolve_rbc_variant(
+            getattr(cfg, "rbc_variant", None)
+        )
         # one shared recorder, bound per node so spans carry identity;
         # one shared registry (the sim is one process, unlike TCP)
         self.recorder = Recorder() if getattr(cfg, "trace", False) else NULL_RECORDER
@@ -197,6 +229,7 @@ class SimNetwork:
                     verify_shares=cfg.verify_shares,
                     engine=engine,
                     recorder=self.recorder.bind(node=nid),
+                    rbc_variant=self.rbc_variant,
                 )
                 for nid in self.ids
             }
@@ -217,6 +250,7 @@ class SimNetwork:
                     rng=random.Random(cfg.seed * 1_000_003 + 2 + idx),
                     engine=engine,
                     recorder=self.recorder.bind(node=nid),
+                    rbc_variant=self.rbc_variant,
                 )
                 for idx, nid in enumerate(self.ids)
             }
@@ -267,6 +301,7 @@ class SimNetwork:
             shuffle=cfg.shuffle,
             recorder=self.recorder,
             metrics=self.metrics,
+            meter_bytes=getattr(cfg, "meter_bytes", False),
         )
         # hbasync tick boundary: the router settles in-flight device
         # work at each quiescence, so completions submitted during a
@@ -307,6 +342,7 @@ class SimNetwork:
         self.__dict__.setdefault("metrics", MetricsRegistry())
         self.__dict__.setdefault("honest_ids", list(self.ids))
         self.__dict__.setdefault("scenario_log", None)
+        self.__dict__.setdefault("rbc_variant", "bracha")
         self.__dict__.setdefault("_dup_seen", {})
         self.__dict__.setdefault("_dup_ids", frozenset(self.ids))
         # pre-round-9 snapshots lack the field: seed from the restored
@@ -365,6 +401,10 @@ class SimNetwork:
             and not cfg.encrypt
             and cfg.coin_mode == "hash"
             and cfg.protocol in ("qhb", "dhb")
+            # bandwidth metering prices router traffic — the native ACS
+            # world has no message plane to meter, so a metered run must
+            # travel the real one
+            and not getattr(cfg, "meter_bytes", False)
         )
         if cfg.native_acs is True:
             if not ok:
@@ -582,12 +622,25 @@ class SimNetwork:
         m.wall_s = self.total_wall_s
         m.messages_delivered = self.router.delivered
         m.faults = len(self.router.faults)
+        m.bytes_tx_total = getattr(self.router, "bytes_tx", 0)
+        m.bytes_rx_total = getattr(self.router, "bytes_rx", 0)
         # progress/agreement are judged over the HONEST nodes: a
         # Byzantine wrapper's core is honest underneath, but liveness-
         # under-attack is a claim about what the honest quorum commits
         honest = getattr(self, "honest_ids", None) or self.ids
         m.epochs_done = min(len(self._batches(nid)) for nid in honest)
         m.agreement_ok = self._check_agreement()
+        if getattr(self.cfg, "meter_bytes", False):
+            # mirror the router's byte ledger into the registry so soak
+            # and bench rows embedding metrics.snapshot() carry it (the
+            # counters are lifetime values: set, not incremented)
+            from ..obs import metrics as M
+
+            self.metrics.counter(M.BYTES_TX_TOTAL).value = m.bytes_tx_total
+            self.metrics.counter(M.BYTES_RX_TOTAL).value = m.bytes_rx_total
+            self.metrics.gauge(M.BYTES_PER_EPOCH).track(
+                round(m.bytes_per_epoch, 1)
+            )
         if self.epoch_durations:
             ordered = sorted(self.epoch_durations)
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import random
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple, TypeVar
 
 from ..consensus.types import Step
@@ -40,12 +40,33 @@ class Router:
         shuffle: bool = False,
         recorder=None,
         metrics=None,
+        meter_bytes: bool = False,
     ):
         self.node_ids = list(node_ids)
         self.handle = handle  # (our_id, sender, message) -> Step
         self.adversary = adversary
         self.rng = random.Random(seed)
         self.shuffle = shuffle
+        # bandwidth metering (round 13, ROADMAP item 2): when on, every
+        # send attempt is priced at its CANONICAL wire size — the codec
+        # encoding of the nested message, the same bytes the TCP tier
+        # would frame — at the two honest chokepoints: tx at _enqueue
+        # (the sender's send, whether or not an adversary then drops or
+        # holds it), rx at deliver_one (what actually arrived, so
+        # adversary-minted duplicates/replays count here).  Off by
+        # default: the encode costs real wall on the hot router path,
+        # so only metered runs (bench config 14, the rbc soak gate) pay
+        # it.
+        self.meter_bytes = meter_bytes
+        self.bytes_tx = 0
+        self.bytes_rx = 0
+        # id -> (message, size): identity-keyed, HOLDING the message so
+        # its id cannot be recycled while cached (a bare id key could
+        # alias a freed tuple's reused address and price a different
+        # message at a stale size).  Bounded FIFO; sized so a queued
+        # frame usually still has its entry when deliver_one prices the
+        # rx side — without it every delivery would re-encode.
+        self._size_cache: "OrderedDict[int, Tuple[Any, int]]" = OrderedDict()
         # hbtrace: the router IS the sim's I/O boundary — it stamps the
         # cores' pending events after each delivery and exports its own
         # queue depth (the sim analogue of the TCP handler queue)
@@ -67,6 +88,11 @@ class Router:
         # re-enters delivery if it did.
         self.drain_hook: Optional[Callable[[], None]] = None
 
+    # size-cache FIFO bound: entries hold references to messages that
+    # are (almost always) sitting in the queue anyway, so the cap only
+    # limits bookkeeping overhead, not message lifetime
+    SIZE_CACHE_CAP = 65536
+
     def __setstate__(self, state):
         """Unpickle (checkpoint resume): obs fields postdate older
         snapshots."""
@@ -74,6 +100,31 @@ class Router:
         self.__dict__.setdefault("obs", _resolve_recorder(None))
         self.__dict__.setdefault("metrics", None)
         self.__dict__.setdefault("drain_hook", None)
+        self.__dict__.setdefault("meter_bytes", False)
+        self.__dict__.setdefault("bytes_tx", 0)
+        self.__dict__.setdefault("bytes_rx", 0)
+        self.__dict__.setdefault("_size_cache", OrderedDict())
+
+    def _msg_size(self, message) -> int:
+        """Canonical wire size of a sim message (codec encoding — the
+        bytes the TCP tier would put in a frame body).  Cached by
+        identity with the object held: a multicast enqueues the SAME
+        object once per recipient and deliver_one prices it again on
+        the rx side, so one encode serves the whole fan-out."""
+        key = id(message)
+        ent = self._size_cache.get(key)
+        if ent is not None and ent[0] is message:
+            return ent[1]
+        from ..utils import codec
+
+        try:
+            size = len(codec.encode(message))
+        except (TypeError, ValueError):
+            size = 0  # non-codec test payloads: meter what we can
+        self._size_cache[key] = (message, size)
+        if len(self._size_cache) > self.SIZE_CACHE_CAP:
+            self._size_cache.popitem(last=False)
+        return size
 
     def dispatch_step(self, sender, step: Step) -> None:
         """Queue a step's messages; record its outputs/faults."""
@@ -93,6 +144,8 @@ class Router:
     MAX_QUEUE = 4_000_000
 
     def _enqueue(self, sender, recipient, message) -> None:
+        if self.meter_bytes:
+            self.bytes_tx += self._msg_size(message)
         if len(self.queue) >= self.MAX_QUEUE:
             # record the terminal depth BEFORE raising: the loud-ceiling
             # post-mortem starts from the high-water gauge
@@ -148,6 +201,8 @@ class Router:
         else:
             item = self.queue.popleft()
         sender, recipient, message = item
+        if self.meter_bytes:
+            self.bytes_rx += self._msg_size(message)
         step = self.handle(recipient, sender, message)
         self.delivered += 1
         if step is not None:

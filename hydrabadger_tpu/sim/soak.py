@@ -453,6 +453,91 @@ def _merge_metrics(snapshots: List[Dict]) -> Dict:
     return out
 
 
+def rbc_soak(epochs: int = 5, n_nodes: int = 16) -> Dict:
+    """Bandwidth-metered RBC variant gate (round 13, ROADMAP item 2):
+    one short sim leg per broadcast variant — Merkle bracha vs the
+    reduced-communication lowcomm — same topology, same seed, the
+    router pricing every frame at its codec wire size.  Asserts the two
+    invariants the variant ships under:
+
+      * committed batches are POINT-IDENTICAL variant-on vs variant-off
+        (the protocol knob changes wire shape, never agreement), and
+      * the bytes/epoch delta is real and in the right direction
+        (lowcomm strictly cheaper — a regression that quietly re-grows
+        the echo tier fails CI here, not in a 64-node bench capture).
+    """
+    from .network import SimConfig, SimNetwork
+
+    def leg(variant: str):
+        net = SimNetwork(
+            SimConfig(
+                n_nodes=n_nodes,
+                protocol="qhb",
+                epochs=epochs,
+                seed=29,
+                rbc_variant=variant,
+                meter_bytes=True,
+                native_acs=False,
+            )
+        )
+        m = net.run()
+        assert m.agreement_ok, f"rbc soak ({variant}) lost agreement"
+        assert m.epochs_done >= epochs, f"rbc soak ({variant}) under-ran"
+        batches = [
+            [
+                (p, tuple(bytes(t) for t in ts))
+                for p, ts in sorted(b.contributions.items())
+            ]
+            for b in net._batches(net.ids[0])
+        ]
+        net.shutdown()
+        return m, batches
+
+    m_bracha, b_bracha = leg("bracha")
+    m_lc, b_lc = leg("lowcomm")
+    assert b_bracha == b_lc, (
+        "rbc soak: committed batches diverged across RBC variants"
+    )
+    assert m_lc.bytes_tx_total > 0 and m_bracha.bytes_tx_total > 0, (
+        "rbc soak: byte metering recorded nothing"
+    )
+    assert m_lc.bytes_per_epoch < m_bracha.bytes_per_epoch, (
+        f"rbc soak: lowcomm not cheaper ({m_lc.bytes_per_epoch:.0f} vs "
+        f"{m_bracha.bytes_per_epoch:.0f} bytes/epoch)"
+    )
+    # the sim legs run the CPU engine (host sketch fold — no lanes);
+    # exercise the DEVICE twin once so the row's occupancy figure is a
+    # real dispatch, not a never-touched gauge reading 0
+    import numpy as _np
+
+    from ..crypto import homhash as _hh
+    from ..obs.metrics import default_registry
+    from ..ops import homhash_jax as _hhj
+
+    probe = _np.arange(n_nodes * 64, dtype=_np.uint8).reshape(n_nodes, 64)
+    assert _np.array_equal(
+        _hhj.sketch_batch(probe, b"rbc-soak"),
+        _hh.sketch_batch_np(probe, b"rbc-soak"),
+    ), "rbc soak: homhash device twin diverged from host"
+    reg = default_registry()
+    return {
+        "tier": f"rbc_lowcomm_{n_nodes}node",
+        "epochs": epochs,
+        "bytes_per_epoch_bracha": round(m_bracha.bytes_per_epoch),
+        "bytes_per_epoch_lowcomm": round(m_lc.bytes_per_epoch),
+        "bytes_reduction": round(
+            1 - m_lc.bytes_per_epoch / m_bracha.bytes_per_epoch, 3
+        ),
+        "epochs_per_sec_bracha": round(m_bracha.epochs_per_sec, 2),
+        "epochs_per_sec_lowcomm": round(m_lc.epochs_per_sec, 2),
+        "homhash_lane_occupancy": reg.gauge(
+            "homhash_lane_occupancy"
+        ).value,
+        "batches_point_identical": True,
+        "agreement_ok": True,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -493,13 +578,28 @@ def main(argv=None) -> int:
                    help="process-chaos tier committed-epoch target "
                    "(counted across the armed window, per surviving "
                    "node)")
+    p.add_argument("--rbc-only", action="store_true",
+                   help="run ONLY the bandwidth-metered RBC variant "
+                   "gate (point-identical batches + bytes/epoch delta "
+                   "bracha vs lowcomm; a scripts/test-all gate)")
+    p.add_argument("--skip-rbc", action="store_true")
+    p.add_argument("--rbc-epochs", type=int, default=5,
+                   help="epochs per RBC-gate leg (two metered legs)")
     p.add_argument("--out", default="SOAK.json")
     args = p.parse_args(argv)
 
     results = []
     only = (
-        args.byz_only or args.wire_only or args.era_only or args.proc_only
+        args.byz_only
+        or args.wire_only
+        or args.era_only
+        or args.proc_only
+        or args.rbc_only
     )
+    if args.rbc_only or (not only and not args.skip_rbc):
+        r = rbc_soak(args.rbc_epochs)
+        print(json.dumps(r), flush=True)
+        results.append(r)
     if not only:
         r = sim_soak(args.epochs)
         print(json.dumps(r), flush=True)
@@ -509,13 +609,13 @@ def main(argv=None) -> int:
         print(json.dumps(r), flush=True)
         results.append(r)
     if not args.skip_byz and not (
-        args.wire_only or args.era_only or args.proc_only
+        args.wire_only or args.era_only or args.proc_only or args.rbc_only
     ):
         r = byz_soak(args.byz_epochs or max(20, args.epochs // 5))
         print(json.dumps(r), flush=True)
         results.append(r)
     if not args.skip_wire and not (
-        args.byz_only or args.era_only or args.proc_only
+        args.byz_only or args.era_only or args.proc_only or args.rbc_only
     ):
         r = wire_chaos_soak(args.wire_epochs)
         print(json.dumps(r), flush=True)

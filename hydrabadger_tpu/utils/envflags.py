@@ -1,0 +1,34 @@
+"""Ambient HYDRABADGER_* knob resolution for the I/O layers.
+
+Consensus cores are sans-io (lint rule ``sans-io``: no ``os`` import
+under consensus/), so environment-driven defaults resolve HERE, at the
+layers that construct cores — sim/network.py, net/node.py, bench/soak
+harnesses — and flow down as explicit constructor arguments.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Reliable-broadcast variants — THE source of truth is the consensus
+# core's own VARIANTS tuple, re-exported so the two validation gates
+# (CLI/env here, Broadcast() there) cannot drift:
+#   bracha  — Bracha echo/ready over RS shards with per-shard Merkle
+#             branches (the hbbft reference protocol; the default and
+#             the fallback).
+#   lowcomm — reduced-communication RBC (PAPERS.md arxiv 2404.08070):
+#             echoes carry bare shards bound by a SHA-256 commitment
+#             over a homomorphic sketch vector; shard verification is
+#             one batched engine fold per instance (crypto/homhash).
+from ..consensus.broadcast import VARIANTS as RBC_VARIANTS  # noqa: E402
+
+
+def resolve_rbc_variant(value: Optional[str] = None) -> str:
+    """Explicit value > ``HYDRABADGER_RBC`` env > ``"bracha"``."""
+    if value is None:
+        value = os.environ.get("HYDRABADGER_RBC", "") or "bracha"
+    if value not in RBC_VARIANTS:
+        raise ValueError(
+            f"unknown RBC variant {value!r}; have {RBC_VARIANTS}"
+        )
+    return value

@@ -1,0 +1,137 @@
+"""Homomorphic shard-sketch plane tests (round 13, ROADMAP item 2).
+
+Three layers, mirroring crypto/homhash.py + ops/homhash_jax.py +
+crypto/engine.py:
+
+  * algebra — the sketch is GF(2^8)-linear over the RS code (the
+    property the low-comm RBC's batched verification rests on) and the
+    counter-mode matrix is prefix-consistent (the property the device
+    twin's length bucketing rests on);
+  * device twin — ops/homhash_jax pinned BIT-IDENTICAL to the host
+    path across shapes, with the lane-occupancy accounting present;
+  * engine contract — CpuEngine and TpuEngine agree, and the submit_
+    future twins return the same values as the sync spellings.
+"""
+import numpy as np
+import pytest
+
+from hydrabadger_tpu.crypto import gf256, homhash
+from hydrabadger_tpu.crypto.engine import CpuEngine, TpuEngine
+from hydrabadger_tpu.crypto.rs import ReedSolomon
+
+
+def _shards(b, length, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(b, length), dtype=np.uint8)
+
+
+# -- algebra -----------------------------------------------------------------
+
+
+def test_sketch_is_linear_over_the_rs_code():
+    """sketch(parity rows) == parity-encode(sketch(data rows)): the
+    sketch commutes with the coding, so per-shard sketches verify a
+    whole codeword without re-encoding it."""
+    rs = ReedSolomon(5, 4)
+    data = _shards(5, 48, seed=1)
+    full = rs.encode(data)
+    sk = homhash.sketch_batch_np(full, b"seed")
+    parity_of_sketches = gf256.matmul(np.asarray(rs.matrix[5:]), sk[:5])
+    assert np.array_equal(parity_of_sketches, sk[5:])
+
+
+def test_matrix_prefix_consistency():
+    """The counter-mode matrix for a longer length extends the shorter
+    one row-for-row — zero-padding shards cannot change a sketch."""
+    short = homhash.matrix_T(b"s", 10)
+    long = homhash.matrix_T(b"s", 64)
+    assert np.array_equal(long[:, :10], short)
+    shards = _shards(3, 10, seed=2)
+    padded = np.zeros((3, 64), dtype=np.uint8)
+    padded[:, :10] = shards
+    assert np.array_equal(
+        homhash.sketch_batch_np(shards, b"s"),
+        homhash.sketch_batch_np(padded, b"s"),
+    )
+
+
+def test_sketch_detects_random_corruption():
+    shards = _shards(6, 33, seed=3)
+    clean = homhash.sketch_batch_np(shards, b"x")
+    shards[2, 7] ^= 0x41
+    dirty = homhash.sketch_batch_np(shards, b"x")
+    assert not np.array_equal(clean[2], dirty[2])
+    # untouched lanes unchanged
+    assert np.array_equal(clean[[0, 1, 3, 4, 5]], dirty[[0, 1, 3, 4, 5]])
+
+
+def test_seed_separates_sketches():
+    shards = _shards(2, 16, seed=4)
+    assert not np.array_equal(
+        homhash.sketch_batch_np(shards, b"a"),
+        homhash.sketch_batch_np(shards, b"b"),
+    )
+
+
+# -- device twin -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("b,length", [(1, 1), (3, 7), (16, 64), (65, 333)])
+def test_device_fold_bit_identical_to_host(b, length):
+    from hydrabadger_tpu.ops import homhash_jax
+
+    shards = _shards(b, length, seed=b * 1000 + length)
+    assert np.array_equal(
+        homhash_jax.sketch_batch(shards, b"twin"),
+        homhash.sketch_batch_np(shards, b"twin"),
+    )
+
+
+def test_device_fold_empty_batch():
+    from hydrabadger_tpu.ops import homhash_jax
+
+    out = homhash_jax.sketch_batch(
+        np.zeros((0, 8), dtype=np.uint8), b"e"
+    )
+    assert out.shape == (0, homhash.SKETCH_BYTES)
+
+
+def test_lane_occupancy_accounting():
+    from hydrabadger_tpu.obs.metrics import default_registry
+    from hydrabadger_tpu.ops import homhash_jax
+
+    reg = default_registry()
+    before = reg.counter("homhash_real_lanes").value
+    homhash_jax.sketch_batch(_shards(5, 12), b"lanes")
+    assert reg.counter("homhash_real_lanes").value == before + 5
+    assert reg.gauge("homhash_lane_occupancy").value > 0
+
+
+def test_submit_split_matches_sync():
+    from hydrabadger_tpu.ops import homhash_jax
+
+    shards = _shards(9, 21, seed=9)
+    fin = homhash_jax.sketch_batch_submit(shards, b"sub")
+    assert np.array_equal(fin(), homhash.sketch_batch_np(shards, b"sub"))
+
+
+# -- engine contract ---------------------------------------------------------
+
+
+def test_engine_twins_agree_and_match_broadcast_constant():
+    from hydrabadger_tpu.consensus import broadcast as bc
+
+    # the sans-io core spells the sketch width as a literal: pin it
+    assert bc.SKETCH_BYTES == homhash.SKETCH_BYTES
+    shards = [bytes(s) for s in _shards(7, 19, seed=7)]
+    cpu = CpuEngine().homhash_batch(shards, b"engine")
+    tpu = TpuEngine().homhash_batch(shards, b"engine")
+    assert cpu == tpu
+    assert all(len(d) == homhash.SKETCH_BYTES for d in cpu)
+    # future twins (PR-5 contract): same values, fetch-once semantics
+    f_cpu = CpuEngine().submit_homhash_batch(shards, b"engine")
+    f_tpu = TpuEngine().submit_homhash_batch(shards, b"engine")
+    assert f_cpu.result() == cpu
+    assert f_tpu.result() == cpu
+    assert CpuEngine().homhash_batch([], b"") == []
+    assert TpuEngine().homhash_batch([], b"") == []

@@ -1,0 +1,311 @@
+"""Low-communication RBC variant tests (round 13, ROADMAP item 2).
+
+Four layers, mirroring consensus/broadcast.py's ``lowcomm`` dialect:
+
+  * protocol — all nodes decide the proposer's value with bare-shard
+    echoes, under shuffle and with f crashed receivers;
+  * adversarial — a garbage shard under the true commitment is rejected
+    LOUDLY by the batched sketch fold (and the instance still decides);
+    a split-commitment equivocator trips the same mixed-root fault the
+    Merkle variant declares (sim/scenario.py FAULT_OBSERVABLES), pinned
+    through a full ScenarioSpec run with ``verify_scenario``;
+  * identity — committed batches are POINT-IDENTICAL variant-on vs
+    variant-off at the sim tier (the knob changes wire shape, never
+    agreement);
+  * bandwidth — the metered router records a real bytes/epoch delta in
+    the right direction, and tx/rx ledgers reconcile.
+"""
+import hashlib
+
+import pytest
+
+from hydrabadger_tpu.consensus import types as T
+from hydrabadger_tpu.consensus.broadcast import (
+    MSG_ECHO_LC,
+    MSG_VALUE_LC,
+    SKETCH_BYTES,
+    Broadcast,
+    lc_commitment,
+)
+from hydrabadger_tpu.consensus.types import NetworkInfo
+from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+from hydrabadger_tpu.sim.router import Router
+from hydrabadger_tpu.sim.scenario import ScenarioSpec
+
+pytestmark = pytest.mark.byz
+
+
+def make_net(n):
+    ids = [f"n{i}" for i in range(n)]
+    return ids, {i: NetworkInfo(i, ids, pk_set=None) for i in ids}
+
+
+def run_broadcast(n, payload, adversary=None, seed=0, shuffle=False):
+    ids, nets = make_net(n)
+    proposer = ids[0]
+    instances = {
+        i: Broadcast(nets[i], proposer, variant="lowcomm") for i in ids
+    }
+    router = Router(
+        ids,
+        lambda me, sender, msg: instances[me].handle_message(sender, msg),
+        adversary=adversary,
+        seed=seed,
+        shuffle=shuffle,
+    )
+    router.dispatch_step(proposer, instances[proposer].broadcast(payload))
+    router.run()
+    return router, instances
+
+
+# -- protocol ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 7, 13])
+def test_all_nodes_decide_lowcomm(n):
+    payload = b"low-comm payload \xff\x00" * 7
+    router, _ = run_broadcast(n, payload)
+    for nid, outs in router.outputs.items():
+        assert outs == [payload], f"{nid} got {outs!r}"
+    assert not router.faults
+
+
+def test_shuffled_delivery_still_decides_lowcomm():
+    for seed in range(5):
+        router, _ = run_broadcast(7, b"shuffle me", seed=seed, shuffle=True)
+        assert all(o == [b"shuffle me"] for o in router.outputs.values())
+
+
+def test_tolerates_f_crashed_receivers_lowcomm():
+    n = 7  # f = 2
+    ids, nets = make_net(n)
+    dead = set(ids[-2:])
+    proposer = ids[0]
+    instances = {
+        i: Broadcast(nets[i], proposer, variant="lowcomm") for i in ids
+    }
+
+    def handle(me, sender, msg):
+        if me in dead:
+            return None
+        return instances[me].handle_message(sender, msg)
+
+    router = Router(ids, handle)
+    router.dispatch_step(proposer, instances[proposer].broadcast(b"x" * 100))
+    router.run()
+    for nid in ids:
+        if nid not in dead:
+            assert router.outputs[nid] == [b"x" * 100]
+
+
+def test_unknown_variant_rejected():
+    ids, nets = make_net(4)
+    with pytest.raises(ValueError, match="unknown RBC variant"):
+        Broadcast(nets["n0"], "n0", variant="nope")
+
+
+def test_cross_variant_kind_is_faulted_not_crashed():
+    """A bracha instance receiving a lowcomm leaf (mixed-dialect
+    misconfiguration or an attacker probing) faults, never raises."""
+    ids, nets = make_net(4)
+    inst = Broadcast(nets["n1"], "n0")  # bracha
+    step = inst.handle_message("n0", (MSG_ECHO_LC, (b"\x00" * 32, b"s")))
+    assert step.fault_log and "unknown message" in step.fault_log[0].kind
+
+
+# -- adversarial -------------------------------------------------------------
+
+
+def test_garbage_shard_rejected_loudly_and_instance_decides():
+    """A Byzantine echoer replaces its shard with garbage under the TRUE
+    commitment: the batched sketch fold names it in the fault log and
+    the decode succeeds from the honest shards."""
+    n = 7
+    ids, nets = make_net(n)
+    proposer, liar = ids[0], ids[3]
+
+    def adversary(sender, recipient, message):
+        if sender == liar and message[0] == MSG_ECHO_LC:
+            commitment, shard = message[1]
+            forged = bytes(len(shard))  # zeroed shard, real commitment
+            return [(sender, recipient, (MSG_ECHO_LC, (commitment, forged)))]
+        return None
+
+    router, _ = run_broadcast(n, b"resilient payload" * 3, adversary=adversary)
+    for nid in ids:
+        assert router.outputs[nid] == [b"resilient payload" * 3]
+    kinds = [f.kind for _nid, f in router.faults]
+    assert any("invalid shard sketch" in k for k in kinds), kinds
+
+
+def test_sketchless_node_survives_garbage_in_base_subset():
+    """A node that never saw the proposer's Value has no sketch vector
+    to pre-filter with; a garbage shard from a LOW-index echoer lands
+    in its first-k decode subset.  The leave-one-out retry must recover
+    the payload — the instance stays live and never terminalizes, and
+    the forged shard is attributed after the successful decode."""
+    n = 7
+    ids, nets = make_net(n)
+    liar, victim = ids[1], ids[5]
+    payload = b"must survive poisoning" * 2
+
+    def adversary(sender, recipient, message):
+        if recipient == victim and message[0] == MSG_VALUE_LC:
+            return []  # victim never learns the sketch vector
+        if sender == liar and message[0] == MSG_ECHO_LC:
+            commitment, shard = message[1]
+            return [
+                (sender, recipient, (MSG_ECHO_LC, (commitment, bytes(len(shard)))))
+            ]
+        return None
+
+    router, instances = run_broadcast(n, payload, adversary=adversary)
+    assert router.outputs[victim] == [payload]
+    assert instances[victim].terminated
+    kinds = [f.kind for _nid, f in router.faults]
+    # post-decode attribution proved the forgery (sketch filter never
+    # saw it on the victim: no Value, no vector)
+    assert any("invalid shard sketch" in k for k in kinds), kinds
+
+
+def test_split_commitment_equivocation_trips_mixed_root_fault():
+    """Hand-rolled equivocation: two self-consistent codings, even/odd
+    peer halves — the lowcomm detector must declare the SAME fault
+    substring the Merkle variant does (the contract's observable)."""
+    n = 4
+    ids, nets = make_net(n)
+    proposer = ids[0]
+    instances = {
+        i: Broadcast(nets[i], proposer, variant="lowcomm") for i in ids
+    }
+    engine = instances[proposer].engine
+    k, p = n - 2, 2  # f = 1
+
+    def coding(payload):
+        shards = engine.rs_encode_bytes(payload, k, p)
+        ph = hashlib.sha256(payload).digest()
+        vec = b"".join(engine.homhash_batch(shards, ph))
+        return ph, vec, shards, lc_commitment(ph, vec, n, k)
+
+    ph_a, vec_a, shards_a, _ = coding(b"coding A" * 4)
+    ph_b, vec_b, shards_b, _ = coding(b"coding B" * 4)
+    faults = []
+    for idx, nid in enumerate(ids[1:], start=1):
+        ph, vec, shards = (
+            (ph_a, vec_a, shards_a) if idx % 2 == 0 else (ph_b, vec_b, shards_b)
+        )
+        step = instances[nid].handle_message(
+            proposer, (MSG_VALUE_LC, (ph, vec, shards[idx]))
+        )
+        # each recipient echoes its own coding; cross-deliver the echoes
+        for tm in step.messages:
+            if tm.message[0] == MSG_ECHO_LC:
+                for other in ids[1:]:
+                    if other != nid:
+                        sub = instances[other].handle_message(
+                            nid, tm.message
+                        )
+                        faults.extend(f.kind for f in sub.fault_log)
+    assert any("mixed echo roots" in k for k in faults), faults
+
+
+def test_equivocate_scenario_under_lowcomm_verifies_contract():
+    """The PR-7 attack harness with the low-comm RBC selected: the
+    equivocation strategy forges a second sketch-commitment coding, the
+    mixed-root detector fires, and verify_scenario holds (a silent
+    detector would RAISE there — the satellite's pin)."""
+    spec = ScenarioSpec(
+        name="lc-equiv", seed=3, byzantine=((3, ("equivocate",)),)
+    )
+    cfg = SimConfig(
+        n_nodes=4,
+        protocol="qhb",
+        epochs=3,
+        seed=3,
+        encrypt=True,
+        verify_shares=True,
+        scenario=spec,
+        rbc_variant="lowcomm",
+    )
+    net = SimNetwork(cfg)
+    m = net.run()
+    assert m.agreement_ok
+    assert m.epochs_done == 3
+    assert net.scenario_log.counts.get(T.BYZ_EQUIVOCATION, 0) > 0
+    net.verify_scenario()  # raises if the injection went unobserved
+    net.shutdown()
+    kinds = {f.kind for _nid, f in net.router.faults}
+    assert any("mixed echo roots" in k for k in kinds), kinds
+
+
+# -- identity + bandwidth ----------------------------------------------------
+
+
+def _metered_leg(variant, n_nodes=8, epochs=2, seed=17, protocol="qhb"):
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=n_nodes,
+            protocol=protocol,
+            epochs=epochs,
+            seed=seed,
+            rbc_variant=variant,
+            meter_bytes=True,
+            native_acs=False,
+        )
+    )
+    m = net.run()
+    assert m.agreement_ok and m.epochs_done == epochs
+    def norm(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(bytes(t) for t in v)
+        return bytes(v)
+
+    batches = [
+        [(p, norm(v)) for p, v in sorted(b.contributions.items())]
+        for b in net._batches(net.ids[0])
+    ]
+    net.shutdown()
+    return m, batches
+
+
+def test_committed_batches_point_identical_across_variants():
+    m_b, b_b = _metered_leg("bracha")
+    m_l, b_l = _metered_leg("lowcomm")
+    assert b_b == b_l
+    assert m_l.bytes_per_epoch < m_b.bytes_per_epoch, (
+        m_l.bytes_per_epoch,
+        m_b.bytes_per_epoch,
+    )
+
+
+def test_byte_meter_ledgers_reconcile():
+    """No adversary, quiescent epochs: every sent frame is delivered,
+    so the tx and rx ledgers must agree exactly, and the metrics
+    registry mirrors both."""
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=4, epochs=2, seed=1, meter_bytes=True, native_acs=False
+        )
+    )
+    m = net.run()
+    assert m.bytes_tx_total > 0
+    assert m.bytes_tx_total == m.bytes_rx_total
+    snap = net.metrics.snapshot()
+    assert snap["counters"]["bytes_tx_total"] == m.bytes_tx_total
+    assert snap["gauges"]["bytes_per_epoch"]["value"] > 0
+    assert m.as_dict()["bytes_per_epoch"] == round(m.bytes_per_epoch, 1)
+
+
+def test_meter_off_by_default_and_costs_nothing():
+    net = SimNetwork(SimConfig(n_nodes=4, epochs=1, seed=1, native_acs=False))
+    m = net.run()
+    assert m.bytes_tx_total == 0 and m.bytes_rx_total == 0
+
+
+def test_dhb_era_switch_under_lowcomm():
+    """The variant must survive the dhb plane end to end — era switch
+    included — since net/ nodes build their cores through the same
+    knob."""
+    m_b, b_b = _metered_leg("bracha", n_nodes=4, epochs=3, protocol="dhb")
+    m_l, b_l = _metered_leg("lowcomm", n_nodes=4, epochs=3, protocol="dhb")
+    assert b_b == b_l

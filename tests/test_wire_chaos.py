@@ -565,6 +565,50 @@ async def test_chaos_cluster_full_crypto_acceptance():
     assert row["recovery_catchup_s"] is not None
 
 
+@pytest.mark.slow
+@pytest.mark.byz
+@pytest.mark.asyncio
+async def test_chaos_cluster_with_lowcomm_rbc(monkeypatch):
+    """Round-13 satellite: the wire-chaos scenario re-run with the
+    low-communication RBC selected (HYDRABADGER_RBC resolves into every
+    node the harness builds, restart included).  Cheaper must also mean
+    fault-tolerant: link faults + a replay-flooding Byzantine peer + a
+    crash/restart, with the wire observability contract intact and the
+    recovery catch-up recorded."""
+    monkeypatch.setenv("HYDRABADGER_RBC", "lowcomm")
+    row = await chaos.chaos_cluster(
+        n=4, f_byz=1, epochs=5, base_port=BASE_PORT + 70,
+        encrypt=False, verify_shares=False, coin_mode="hash",
+        wire_sign=False, strategies=("replay_flood",),
+        crash=True, crash_down_s=1.5, deadline_s=240,
+    )
+    assert row["agreement_ok"] and row["contract_ok"]
+    assert row["epochs"] >= 5
+    assert row["recovery_catchup_s"] is not None
+    # bandwidth counters ride the wire tier unconditionally: the nodes'
+    # registries must have metered real framed bytes
+    assert row.get("bytes_tx_total", 0) > 0
+
+
+@pytest.mark.byz
+@pytest.mark.asyncio
+async def test_equivocating_peer_detected_over_tcp_lowcomm(monkeypatch):
+    """The split-commitment equivocator over real sockets with the
+    low-comm dialect: the mixed-root detector must fire exactly as the
+    Merkle variant's does, through the same contract."""
+    monkeypatch.setenv("HYDRABADGER_RBC", "lowcomm")
+    row = await chaos.chaos_cluster(
+        n=4, f_byz=1, epochs=4, base_port=BASE_PORT + 80,
+        encrypt=False, verify_shares=False, coin_mode="hash",
+        wire_sign=False, strategies=("equivocate",),
+        spec=WireChaosSpec(name="clean"),  # isolate the attack
+        crash=False, deadline_s=180,
+    )
+    assert row["agreement_ok"] and row["contract_ok"]
+    assert row["byz_injected"].get("equivocation", 0) > 0
+    assert row["byz_faults"].get("byz_faults_equivocation", 0) > 0
+
+
 @pytest.mark.asyncio
 async def test_equivocating_peer_detected_over_tcp():
     """The equivocate strategy over real sockets (no crash: a split

@@ -531,7 +531,10 @@ def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
     except Exception:
         pass
 
-    # Python-core dispatch calibration (per-message cost at 16 nodes)
+    # Python-core dispatch calibration (per-message cost at 16 nodes).
+    # UNTRACED — py_per_msg feeds the vs_baseline dispatch ratio, whose
+    # history predates the timeline plane; folding tracing overhead in
+    # would shift the ratio with zero dispatch-code change.
     cal = SimNetwork(
         SimConfig(n_nodes=16, protocol="dhb", txns_per_node_per_epoch=4,
                   txn_bytes=2, seed=7, native_acs=False)
@@ -539,6 +542,16 @@ def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
     t0 = _time.perf_counter()
     cal.run(2)
     py_per_msg = (_time.perf_counter() - t0) / max(1, cal.router.delivered)
+    # Separate TRACED leg (round 14), same topology class: the row's
+    # cluster-timeline attribution (straggler node + gating stage +
+    # msg latency) comes from here — the main topology below rides the
+    # native ACS world, which has no message plane to trace.
+    tl_net = SimNetwork(
+        SimConfig(n_nodes=16, protocol="dhb", txns_per_node_per_epoch=4,
+                  txn_bytes=2, seed=7, native_acs=False, trace=True)
+    )
+    tl_net.run(2)
+    timeline = tl_net.timeline_report() or {}
 
     txns_per_node = max(1, 4096 // n_nodes)
     t_total0 = _time.perf_counter()
@@ -616,6 +629,15 @@ def _dhb_churn_config5(n_nodes: int, epochs: int) -> dict:
         "era_gap_vs_steady": era_gap["era_gap_vs_steady"],
         "shadow_dkg": era_gap["shadow_dkg"],
         "shadow_dkg_stall_epochs": era_gap["shadow_dkg_stall_epochs"],
+        # round 14 cluster timeline: attributed from the python-core
+        # calibration leg above (same topology class as vs_baseline's
+        # denominator) — the main run's native-ACS world has no
+        # message plane to trace, and the provenance field says so
+        "epoch_critical_stage": timeline.get("epoch_critical_stage"),
+        "straggler_node": timeline.get("straggler_node"),
+        "msg_latency_p99_s": timeline.get("msg_latency_p99_s"),
+        "commit_spread_max_s": timeline.get("commit_spread_max_s"),
+        "timeline_source": "python_core_calibration_leg_16node",
         "device_overlap_has_device": era_gap["device_overlap_has_device"],
         "total_wall_s": round(_time.perf_counter() - t_total0, 1),
         # hbasync: device overlap through the era switch (obs/metrics
@@ -1077,6 +1099,11 @@ def _wire_chaos_config12(epochs: int = 10) -> dict:
         "unit": "s (longest inter-commit gap under fault)",
         "recovery_catchup_s": row["recovery_catchup_s"],
         "epochs_per_sec_under_fault": row["epochs_per_sec"],
+        # cluster-timeline headline (round 14): which node's which
+        # stage gated the epochs committed under fault
+        "epoch_critical_stage": row["epoch_critical_stage"],
+        "straggler_node": row["straggler_node"],
+        "msg_latency_p99_s": row["msg_latency_p99_s"],
         "run": row,
         "note": (
             "4-node full-crypto TCP with f=1 Byzantine peer, link "
@@ -1223,6 +1250,16 @@ def _process_chaos_config13(epochs: int = 3) -> dict:
         "unit": "s (longest inter-commit gap under a real SIGKILL)",
         "recovery_catchup_s": row["recovery_catchup_s"],
         "epochs_per_sec_under_fault": row["epochs_per_sec"],
+        # cluster-timeline headline (round 14, obs/aggregate over the
+        # children's trace/flight/batch feeds, skew-corrected): the
+        # straggler node and gating stage of the epochs committed
+        # across a real SIGKILL, plus the cross-process message-latency
+        # tail and the black-box census
+        "epoch_critical_stage": row["epoch_critical_stage"],
+        "straggler_node": row["straggler_node"],
+        "msg_latency_p99_s": row["msg_latency_p99_s"],
+        "clock_alignment": row["clock_alignment"],
+        "flight_dumps_found": row["flight_dumps_found"],
         # provenance rides the row like config-5/12: the children pin
         # JAX_PLATFORMS=cpu (consensus workloads), so this reports the
         # SUPERVISOR host's backend honestly rather than implying the
@@ -1244,6 +1281,74 @@ def _process_chaos_config13(epochs: int = 3) -> dict:
     }
 
 
+def _trace_overhead_config15(epochs: int = 5, legs: int = 3) -> dict:
+    """Round-14 tracing-overhead leg: the cluster-timeline plane added
+    wire-event stamps (wire_tx/wire_rx per router enqueue/delivery) on
+    top of the existing span tracing — this row pins THEIR cost.  Same
+    16-node qhb topology on the real message plane, both legs traced,
+    differing only in SimConfig.trace_wire; legs alternate (cancels
+    thermal/cache drift) and medians compare.  The wire-event leg must
+    hold >= 95% of the spans-only epochs/s — the <5% budget the stamps
+    ship under.  (Full tracing vs untraced is a separate, looser
+    contract: tests/test_obs.py's overhead guard.)"""
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    def leg(trace_wire: bool) -> tuple:
+        net = SimNetwork(
+            SimConfig(
+                n_nodes=16, protocol="qhb", epochs=epochs, seed=31,
+                native_acs=False, trace=True, trace_wire=trace_wire,
+            )
+        )
+        m = net.run()
+        assert m.agreement_ok
+        wire_events = sum(
+            1 for e in net.recorder.events if e.name == "wire_tx"
+        )
+        net.shutdown()
+        return m.epochs_per_sec, wire_events
+
+    spans_only, with_wire = [], []
+    wire_events = 0
+    for _ in range(legs):
+        spans_only.append(leg(False)[0])
+        eps, wire_events = leg(True)
+        with_wire.append(eps)
+    spans_only.sort()
+    with_wire.sort()
+    ratio = with_wire[len(with_wire) // 2] / spans_only[len(spans_only) // 2]
+    assert wire_events > 0, "config15: wire leg recorded no wire events"
+    assert ratio >= 0.95, (
+        f"config15: wire-event stamps cost {(1 - ratio):.1%} epochs/s "
+        "(> 5% budget)"
+    )
+    return {
+        "metric": "trace_wire_overhead_epochs_per_sec_ratio_16node",
+        "value": round(ratio, 4),
+        "unit": (
+            "wire-events-on/spans-only epochs-per-sec ratio "
+            "(>= 0.95 asserted)"
+        ),
+        "epochs_per_leg": epochs,
+        "legs": legs,
+        "epochs_per_sec_spans_only": round(
+            spans_only[len(spans_only) // 2], 3
+        ),
+        "epochs_per_sec_with_wire_events": round(
+            with_wire[len(with_wire) // 2], 3
+        ),
+        "wire_tx_events": wire_events,
+        "note": (
+            "median of alternating legs, both with span tracing on; "
+            "the measured delta is the wire_tx/wire_rx stamps at the "
+            "router enqueue/delivery chokepoints (default 1-in-32 "
+            "seq-deterministic sampling — SimConfig.trace_wire_sample; "
+            "tags extracted once per sampled message and carried with "
+            "the queue entry)"
+        ),
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1251,7 +1356,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14],
+        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
@@ -1271,7 +1376,10 @@ def main(argv=None) -> int:
         "gap and recovery catch-up under a genuine process death), "
         "14 = RBC bandwidth row (bytes/epoch + epochs/s for the bracha "
         "and low-comm broadcast variants at 16/64 nodes on the metered "
-        "message plane; committed batches pinned point-identical)",
+        "message plane; committed batches pinned point-identical), "
+        "15 = tracing-overhead leg (spans-only vs spans+wire-event "
+        "epochs/s, both traced, on the 16-node message plane; the "
+        "cluster-timeline wire-event stamps' increment must cost <5%%)",
     )
     p.add_argument(
         "--rbc",
@@ -1385,6 +1493,10 @@ def main(argv=None) -> int:
              lambda: _rbc_bytes_config14(
                  epochs_or(4), max(1, epochs_or(4) // 2)
              ), "always"),
+            # tracing overhead: pure host sim either way — pins the
+            # cluster-timeline wire-event stamps under their 5% budget
+            ("config15_trace_overhead",
+             lambda: _trace_overhead_config15(epochs_or(5)), "always"),
         ]
         jax_ok = not probe.get("error")
         backend_lost = False
@@ -1523,6 +1635,8 @@ def main(argv=None) -> int:
                 epochs_or(4), max(1, epochs_or(4) // 2)
             )
         )
+    if args.config == 15:
+        return single(lambda: _trace_overhead_config15(epochs_or(5)))
 
     # config 3 (also the fall-through for the bare invocation)
     return single(_rs_throughput_config3)

@@ -21,6 +21,11 @@ from .net.node import Config, Hydrabadger
 from .obs import logging as obs_logging
 from .utils.ids import InAddr, OutAddr
 
+# default flight-recorder directory (shared with the other tmp/obs
+# artifacts); ONE constant serves both the argparse const and the
+# directory-vs-prefix branch below
+FLIGHT_DEFAULT_DIR = "tmp/obs"
+
 
 def _parse_addr(spec: str):
     host, _, port = spec.rpartition(":")
@@ -172,6 +177,21 @@ def make_parser() -> argparse.ArgumentParser:
         "agreement/identity feed the cluster supervisor asserts over",
     )
     p.add_argument(
+        "--flight",
+        nargs="?",
+        const=FLIGHT_DEFAULT_DIR,
+        default=None,
+        metavar="DIR|PREFIX",
+        help="mount the flight recorder (obs/flight.py): a bounded "
+        "black box of recent spans/wire events + fault-ring mirror, "
+        "dumped atomically (generational, digest-checked) on every "
+        "fault-ring entry, a periodic heartbeat, and SIGTERM — the "
+        "dump a SIGKILL cannot retract.  A directory (default tmp/obs) "
+        "gets <uid>.flight.<pid>.json; anything else is used as the "
+        "path prefix.  Implies an in-memory recorder even without "
+        "--trace",
+    )
+    p.add_argument(
         "--mine",
         action="store_true",
         help="run the toy PoW blockchain demo and exit (peer_node.rs:81-92)",
@@ -229,10 +249,13 @@ def main(argv=None) -> int:
         cfg.wire_sign = False
 
     recorder = None
-    if args.trace:
+    if args.trace or args.flight:
         from .obs.recorder import Recorder
 
-        recorder = Recorder()
+        # the TCP node's stamping boundaries read the node wall clock
+        # (declared domain; re-pointed at node.wall_now below so
+        # injected skew is honestly visible in the trace)
+        recorder = Recorder(clock_domain="wall")
         # warnings interleave with the spans they explain
         obs_logging.attach_recorder(recorder)
 
@@ -260,6 +283,30 @@ def main(argv=None) -> int:
         node = Hydrabadger(
             InAddr(host, port), cfg, seed=args.seed, recorder=recorder
         )
+    if recorder is not None:
+        # emit_stamped consumers without their own clock (the logging
+        # mirror) read the node's skewed wall clock too
+        recorder.clock = node.wall_now
+    if args.flight:
+        import os as _os
+
+        from .obs.flight import FlightRecorder
+
+        uid8 = node.uid.bytes.hex()[:8]
+        prefix = (
+            _os.path.join(args.flight, f"{uid8}.flight")
+            if args.flight.endswith(_os.sep) or _os.path.isdir(args.flight)
+            or args.flight == FLIGHT_DEFAULT_DIR
+            else args.flight
+        )
+        node.flight = FlightRecorder(
+            prefix,
+            node=uid8,
+            recorder=recorder,
+            metrics=node.metrics,
+            fault_ring=node.fault_log,
+            clock=node.wall_now,
+        )
     remotes = [OutAddr(h, p) for h, p in args.remote_address]
 
     stop_reason = {"why": "exit"}
@@ -270,13 +317,18 @@ def main(argv=None) -> int:
 
     def summary_line(final: bool) -> dict:
         """One machine-readable fault/metrics summary: what the
-        process-tier supervisor folds into its observability contract."""
+        process-tier supervisor folds into its observability contract.
+        ``t`` is the NODE's wall clock (wall_now): injected skew rides
+        the feed for the aggregator to correct, not to hide.
+        ``t_host`` is the honest host clock — supervisor-side plumbing
+        (feed-freshness checks) that must NOT see the skew reads it."""
         import os as _os
         import time as _t
 
         snap = node.metrics.snapshot()
         return {
-            "t": _t.time(),
+            "t": node.wall_now(),
+            "t_host": _t.time(),
             # counters reset when a killed node's replacement process
             # reuses the same file: the supervisor separates
             # incarnations by pid before summing
@@ -348,7 +400,12 @@ def main(argv=None) -> int:
                     ).hexdigest()[:16]
                     with open(args.batch_log, "a") as fh:
                         fh.write(json.dumps({
-                            "t": _t.time(),
+                            # node wall clock: the committed-batch
+                            # anchor the aggregator aligns clocks with;
+                            # t_host is the honest host clock for
+                            # supervisor-side gap bookkeeping
+                            "t": node.wall_now(),
+                            "t_host": _t.time(),
                             "epoch": batch.epoch,
                             "era": batch.era,
                             "digest": h.hexdigest(),
@@ -362,9 +419,24 @@ def main(argv=None) -> int:
                 await asyncio.sleep(args.metrics_interval)
                 append_summary()
 
+        async def flight_loop():
+            # heartbeat dump: even a fault-free incarnation that takes
+            # a SIGKILL leaves a black box at most one interval stale
+            # (skipped while nothing new was recorded).  Its own task —
+            # the black-box contract must not depend on --metrics
+            # being streamed too.
+            interval = (
+                args.metrics_interval if args.metrics_interval > 0 else 1.0
+            )
+            while True:
+                await asyncio.sleep(interval)
+                node.flight.maybe_dump("periodic")
+
         tasks = [asyncio.create_task(log_batches())]
         if metrics_jsonl and args.metrics_interval > 0:
             tasks.append(asyncio.create_task(summary_loop()))
+        if node.flight is not None:
+            tasks.append(asyncio.create_task(flight_loop()))
         gen = gen_txns_factory(args.seed)
         try:
             await node.run_node(
@@ -380,12 +452,23 @@ def main(argv=None) -> int:
         stop_reason["why"] = "keyboard_interrupt"
     finally:
         if args.trace and recorder is not None:
+            import os as _os
+
             from .obs import export as obs_export
 
+            meta = {
+                "clock_domain": recorder.clock_domain,
+                "node": node.uid.bytes.hex()[:8],
+                "pid": _os.getpid(),
+            }
             if args.trace.endswith(".jsonl"):
-                n = obs_export.write_jsonl(recorder.events, args.trace)
+                n = obs_export.write_jsonl(
+                    recorder.events, args.trace, meta=meta
+                )
             else:
-                n = obs_export.write_chrome_trace(recorder.events, args.trace)
+                n = obs_export.write_chrome_trace(
+                    recorder.events, args.trace, meta=meta
+                )
             print(f"trace: {n} events -> {args.trace}", file=sys.stderr)
         if metrics_jsonl:
             append_summary(final=True)

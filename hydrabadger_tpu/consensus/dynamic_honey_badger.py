@@ -981,18 +981,34 @@ class DynamicHoneyBadger:
         budget = _shadow_budget()
         chunk = state.shadow_queue[:budget]
         del state.shadow_queue[:budget]
+        # the DKG-settle stage span (cluster timeline, round 14): the
+        # per-epoch shadow slot is the one DKG cost still riding the
+        # commit path, so it competes with RBC/BA/subset/tdec for an
+        # epoch's critical path and must be attributable like them.
+        # Epoch is the ERA-LOCAL hb epoch — the key the other stage
+        # spans and the epoch span itself carry.
+        obs = getattr(self, "obs", _resolve_recorder(None))
+        obs.begin(
+            "dkg_settle", era=self.era, epoch=self.hb.epoch,
+            parts=len(chunk),
+        )
         try:
-            settle = kg.settle_parts_submit(list(chunk))
-        except (ValueError, TypeError, KeyError):
-            for proposer, _part in chunk:
-                step.fault(proposer, "dhb: keygen part batch failed")
-            return
-        if _futures.enabled():
-            prev, self._kg_inflight = self._kg_inflight, (list(chunk), settle)
-            if prev is not None:
-                self._settle_flush(prev, step)
-        else:
-            self._settle_flush((list(chunk), settle), step)
+            try:
+                settle = kg.settle_parts_submit(list(chunk))
+            except (ValueError, TypeError, KeyError):
+                for proposer, _part in chunk:
+                    step.fault(proposer, "dhb: keygen part batch failed")
+                return
+            if _futures.enabled():
+                prev, self._kg_inflight = (
+                    self._kg_inflight, (list(chunk), settle),
+                )
+                if prev is not None:
+                    self._settle_flush(prev, step)
+            else:
+                self._settle_flush((list(chunk), settle), step)
+        finally:
+            obs.end("dkg_settle", era=self.era, epoch=self.hb.epoch)
 
     def _maybe_emit_cutover(self, step: Step) -> None:
         """Once SEALED and fully settled: pre-generate the next era's

@@ -386,6 +386,11 @@ ENV_FLAGS = {
         "replays/stall declarations fire early) (net/node, net/cluster)"
     ),
     "HYDRABADGER_LOG": "structured logging level/filter spec (obs/logging)",
+    "HYDRABADGER_FLIGHT": (
+        "0 disables flight-recorder dumps (the black-box ring keeps "
+        "recording; the atomic generational dump on fault-ring entries "
+        "/ heartbeat / SIGTERM is skipped) (obs/flight)"
+    ),
     "HYDRABADGER_NO_NATIVE_BLS": (
         "1 disables the native BLS library (crypto/native_bls)"
     ),

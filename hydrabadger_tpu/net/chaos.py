@@ -566,6 +566,7 @@ async def chaos_cluster(
     crash_down_s: float = 4.0,
     seed: int = 0,
     deadline_s: float = 600.0,
+    trace: bool = True,
 ) -> dict:
     """The acceptance scenario, end to end: an ``n``-node localhost
     cluster with the last ``f_byz`` nodes Byzantine, link faults armed
@@ -602,8 +603,19 @@ async def chaos_cluster(
     if spec is None:
         spec = default_wire_spec(n, byz_idx, wire_sign, seed)
     plane = ChaosPlane(spec)
+    from ..obs.recorder import Recorder
     from ..utils.ids import InAddr, OutAddr
 
+    # one shared recorder (this harness is one process, one wall
+    # clock), bound per node by each Hydrabadger: the row's cluster-
+    # timeline fields (straggler node, gating stage, msg latency) come
+    # from aggregating it — the wire-chaos twin of config 13's
+    # file-based aggregation.  trace=False reproduces the
+    # pre-timeline measurement conditions (no per-frame digest/stamp
+    # cost; the timeline fields then read None) — the cost is small at
+    # this tier's frame rates, but the knob keeps the fault-tolerance
+    # metrics re-measurable under the old conditions.
+    rec = Recorder(clock_domain="wall") if trace else None
     gen = lambda count, size: [b"%02dx" % i * size for i in range(count)]  # noqa: E731
     nodes: List[Hydrabadger] = []
     for i in range(n):
@@ -612,10 +624,12 @@ async def chaos_cluster(
             node = ByzantineHydrabadger(
                 bind, cfg, strategies=strategies,
                 injection_log=plane.log, byz_seed=seed + i,
-                seed=seed * 1000 + i, chaos=plane,
+                seed=seed * 1000 + i, chaos=plane, recorder=rec,
             )
         else:
-            node = Hydrabadger(bind, cfg, seed=seed * 1000 + i, chaos=plane)
+            node = Hydrabadger(
+                bind, cfg, seed=seed * 1000 + i, chaos=plane, recorder=rec
+            )
         plane.register(node.uid.bytes, i)
         nodes.append(node)
     honest_idx = [i for i in range(n) if not (f_byz and i >= n - f_byz)]
@@ -712,6 +726,7 @@ async def chaos_cluster(
                 cfg,
                 seed=seed * 1000 + victim_i + 500,
                 chaos=plane,
+                recorder=rec,
             )
             incarnations.append(restarted)
             nodes[victim_i] = restarted
@@ -790,6 +805,15 @@ async def chaos_cluster(
                 await m.stop()
         await plane.drain()
 
+        # -- the cluster timeline (round 14) ---------------------------------
+        # one shared recorder, one clock: no alignment pass — straight
+        # to critical-path + message-latency attribution
+        from ..obs.aggregate import aggregate_events
+
+        timeline = (
+            aggregate_events(list(rec.events)) if rec is not None else {}
+        )
+
         # -- the contract ----------------------------------------------------
         assert_wire_scenario(plane, live)
         merged = merge_node_metrics(live, plane.metrics)
@@ -829,6 +853,18 @@ async def chaos_cluster(
                 else None
             ),
             "byz_injected": dict(plane.log.counts),
+            # cluster-timeline headline fields (obs/aggregate.py):
+            # which node's which stage gated the epochs committed under
+            # fault, and the wire-event message latency tail the chaos
+            # plane's delays/stalls actually produced (None on
+            # trace=False runs)
+            "timeline_traced": bool(trace),
+            "epoch_critical_stage": timeline.get("epoch_critical_stage"),
+            "straggler_node": timeline.get("straggler_node"),
+            "msg_latency_p50_s": timeline.get("msg_latency_p50_s"),
+            "msg_latency_p99_s": timeline.get("msg_latency_p99_s"),
+            "commit_spread_max_s": timeline.get("commit_spread_max_s"),
+            "epochs_attributed": timeline.get("epochs_attributed"),
             "byz_faults": {
                 k: v for k, v in sorted(snap.items())
                 if k.startswith(BYZ_FAULTS_PREFIX)
@@ -871,6 +907,12 @@ def main(argv=None) -> int:
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--base-port", type=int, default=3900)
     p.add_argument("--no-crash", action="store_true")
+    p.add_argument(
+        "--no-trace", action="store_true",
+        help="skip the cluster-timeline recorder (reproduces the "
+        "pre-round-14 measurement conditions; timeline row fields "
+        "read None)",
+    )
     p.add_argument("--fast", action="store_true",
                    help="fast crypto tier (no encryption/threshold coin); "
                    "drops the share-forging strategies that need the "
@@ -879,7 +921,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     kw: dict = dict(
         n=args.nodes, epochs=args.epochs, base_port=args.base_port,
-        crash=not args.no_crash,
+        crash=not args.no_crash, trace=not args.no_trace,
     )
     if args.fast:
         kw.update(

@@ -204,11 +204,14 @@ class ChildNode:
     metrics_path: str
     batch_log_path: str
     stdout_path: str
+    trace_path: str = ""
+    flight_prefix: str = ""
     env_extra: Dict[str, str] = field(default_factory=dict)
     proc: Optional[subprocess.Popen] = None
     restarts: int = 0
     last_exit: Optional[int] = None
     last_spawn_t: float = 0.0
+    killed_pids: List[int] = field(default_factory=list)
 
     @property
     def alive(self) -> bool:
@@ -267,6 +270,11 @@ class ClusterSupervisor:
                     metrics_path=os.path.join(workdir, f"node{i}.metrics.jsonl"),
                     batch_log_path=os.path.join(workdir, f"node{i}.batches.jsonl"),
                     stdout_path=os.path.join(workdir, f"node{i}.log"),
+                    # cluster-timeline feeds (round 14): span trace
+                    # dumped at exit, flight black boxes (pid-tagged)
+                    # dumped throughout — the SIGKILL-surviving half
+                    trace_path=os.path.join(workdir, f"node{i}.trace.jsonl"),
+                    flight_prefix=os.path.join(workdir, f"node{i}.flight"),
                     env_extra=env_extra,
                 )
             )
@@ -285,6 +293,8 @@ class ClusterSupervisor:
             "--metrics", child.metrics_path,
             "--metrics-interval", str(self.metrics_interval_s),
             "--batch-log", child.batch_log_path,
+            "--trace", child.trace_path,
+            "--flight", child.flight_prefix,
         ]
         for other in self.children:
             if other.index != child.index:
@@ -331,6 +341,10 @@ class ClusterSupervisor:
             raise RuntimeError(f"node {i} is not running")
         self.log.note(T.BYZ_CRASH)
         self.metrics.counter("proc_sigkills").inc()
+        # remember the killed incarnation's pid: its flight dump
+        # (<prefix>.<pid>.json) is the only record the kill didn't
+        # retract, and the black-box assertion looks it up by pid
+        child.killed_pids.append(child.proc.pid)
         os.kill(child.proc.pid, signal.SIGKILL)
         child.last_exit = child.proc.wait()
         child.proc = None
@@ -457,6 +471,10 @@ class ClusterSupervisor:
         report = []
         for child in self.children:
             s = self.last_summary(child.index)
+            # feed freshness compares against the HONEST host clock
+            # (t_host, round 14) — the skewed node clock in `t` is the
+            # aggregator's anchor, and measuring staleness with it
+            # would make a skewed-fast node's feed look eternally fresh
             report.append(
                 {
                     "node": child.index,
@@ -465,12 +483,44 @@ class ClusterSupervisor:
                     "last_exit": child.last_exit,
                     "state": s.get("state") if s else None,
                     "summary_age_s": (
-                        round(now - s["t"], 2) if s else None
+                        round(now - s.get("t_host", s["t"]), 2)
+                        if s else None
                     ),
                     "frontier": self.frontier(child.index),
                 }
             )
         return report
+
+    # -- flight black boxes ----------------------------------------------------
+
+    def flight_dumps(self, i: int):
+        """Every loadable flight dump node ``i``'s incarnations left
+        (pid-tagged paths; torn/corrupt generations rejected with
+        fallback to ``.1``).  Returns (payloads, rejected_paths)."""
+        import glob as _glob
+
+        from ..obs.flight import load_flight_with_fallback
+
+        payloads, rejected = [], []
+        for path in sorted(
+            _glob.glob(self.children[i].flight_prefix + ".*.json")
+        ):
+            payload, rej = load_flight_with_fallback(path)
+            rejected.extend(rej)
+            if payload is not None:
+                payloads.append(payload)
+        return payloads, rejected
+
+    def killed_flight_dump(self, i: int):
+        """The black box of node ``i``'s most recently SIGKILLed
+        incarnation (None if the kill outran every dump — a contract
+        violation the harness asserts against)."""
+        pids = set(self.children[i].killed_pids)
+        payloads, _rej = self.flight_dumps(i)
+        for payload in payloads:
+            if payload.get("pid") in pids:
+                return payload
+        return None
 
     # -- the contract ----------------------------------------------------------
 
@@ -761,12 +811,34 @@ def run_process_chaos(
         )
 
         # -- commit-gap under fault (the watch node's batch timestamps) -------
+        # host-clock stamps (t_host): a skewed watch node's drift rate
+        # must not inflate/deflate the headline gap metric
         times = sorted(
-            row["t"] for row in sup.batches(watch)
+            row.get("t_host", row["t"]) for row in sup.batches(watch)
             if row["epoch"] > base_frontier[watch]
         )
         gaps = [b - a for a, b in zip(times, times[1:])]
         commit_gap_max_s = max(gaps) if gaps else None
+
+        # -- the cluster timeline (round 14) -----------------------------------
+        # merge every feed the run left — trace dumps, flight black
+        # boxes, batch logs — into one skew-corrected timeline; the
+        # killed node's dump and >= 1 attributed critical path are part
+        # of the acceptance contract
+        from ..obs.aggregate import aggregate_dir
+
+        timeline = aggregate_dir(workdir)
+        for node_i in {k.node for k in kills if k.sig == "kill"}:
+            assert sup.killed_flight_dump(node_i) is not None, (
+                f"SIGKILLed node {node_i} left no loadable flight dump "
+                "(black-box contract)"
+            )
+        attributed = [
+            r for r in timeline["epochs"] if r["critical_stage"] != "unknown"
+        ]
+        assert attributed, (
+            "cluster timeline attributed no epoch's critical path"
+        )
 
         # -- the contract ------------------------------------------------------
         assert_process_scenario(sup)
@@ -813,6 +885,18 @@ def run_process_chaos(
             "supervisor_rss_end_mb": round(rss1, 1),
             "supervisor_rss_growth_mb": round(rss1 - rss0, 1),
             "byz_injected": dict(sup.log.counts),
+            # cluster-timeline headline fields (obs/aggregate.py):
+            # which node's which stage gated the committed epochs, with
+            # the skew-corrected clock fits and the black-box census
+            "epoch_critical_stage": timeline["epoch_critical_stage"],
+            "straggler_node": timeline["straggler_node"],
+            "msg_latency_p50_s": timeline["msg_latency_p50_s"],
+            "msg_latency_p99_s": timeline["msg_latency_p99_s"],
+            "commit_spread_max_s": timeline["commit_spread_max_s"],
+            "epochs_attributed": len(attributed),
+            "clock_alignment": timeline["clock"]["alignment"],
+            "flight_dumps_found": len(timeline["flight"]["found"]),
+            "flight_dumps_rejected": len(timeline["flight"]["rejected"]),
             "detections": {
                 k: merged.get(k, 0)
                 for k in (

@@ -411,9 +411,14 @@ class Hydrabadger:
         # node's replay/backoff/gap timers read the skewed clock — a
         # node whose timers run 1.5x fast genuinely replays early and
         # declares stalls sooner, the OS-level timing tail the
-        # in-process planes cannot model.  Confined to timestamps this
-        # node both WRITES and READS (progress/replay/gap bookkeeping);
-        # cross-object timestamps (peer.born) stay on the host clock.
+        # in-process planes cannot model.  Two consumers: the monotonic
+        # timer clock (_now: progress/replay/gap bookkeeping this node
+        # both writes and reads), and the WALL clock this node stamps
+        # its observability feeds with (wall_now: trace events, batch
+        # log, summary lines) — the skewed feeds are exactly what the
+        # cluster aggregator (obs/aggregate.py) must CORRECT from
+        # committed-batch anchors rather than trust.  Cross-object
+        # timestamps (peer.born) stay on the host clock.
         self._clock_offset_s = float(
             _os.environ.get("HYDRABADGER_CLOCK_SKEW_S") or 0.0
         )
@@ -427,6 +432,10 @@ class Hydrabadger:
         # rejection/fallback inside the store lands in this node's
         # fault ring + metrics, so the supervisor-tier observability
         # contract sees disk corruption exactly like a wire fault
+        # flight recorder (obs/flight.py): mounted by the harness
+        # (__main__ --flight / the cluster supervisor); every fault-ring
+        # entry and the graceful stop dump the black box
+        self.flight = None
         self._ckpt_store = None
         self._ckpt_inflight = None  # at most one executor write in flight
         if self.cfg.checkpoint_path:
@@ -441,6 +450,20 @@ class Hydrabadger:
     def _now(self) -> float:
         """This node's monotonic clock, with injected skew applied."""
         return self._clock_offset_s + self._clock_rate * _time.monotonic()
+
+    def wall_now(self) -> float:
+        """This node's WALL clock — host wall time plus the injected
+        offset and drift (drift accrues on the monotonic axis so the
+        result stays a plausible epoch timestamp).  Every observability
+        feed this node writes (trace stamps, wire events, batch-log /
+        summary ``t`` fields) reads THIS clock, so the process-tier
+        chaos harness's skew is visible in the feeds and the cluster
+        aggregator genuinely has to correct it."""
+        return (
+            _time.time()
+            + self._clock_offset_s
+            + (self._clock_rate - 1.0) * _time.monotonic()
+        )
 
     # -- public API (hydrabadger.rs:127-603) --------------------------------
 
@@ -698,6 +721,10 @@ class Hydrabadger:
             except Exception:
                 pass  # already logged by its done-callback
         self._persist_checkpoint(sync=True)
+        if self.flight is not None:
+            # black-box contract: a graceful stop (SIGTERM tier) leaves
+            # a final flight dump next to the final checkpoint
+            self.flight.dump("stop")
         if self._server is not None:
             self._server.close()
         self.peers.close_all()
@@ -725,6 +752,11 @@ class Hydrabadger:
         # bandwidth accounting (round 13): framed bytes counted at the
         # stream, attributed to this node's registry
         stream.metrics = self.metrics
+        # cluster-timeline correlation (round 14): the stream stamps
+        # wire_tx/wire_rx events into this node's bound recorder on the
+        # node's (possibly skewed) wall clock
+        stream.obs = self.obs
+        stream.clock = self.wall_now
         return stream
 
     def _wrap_dhb(self, dhb):
@@ -738,10 +770,15 @@ class Hydrabadger:
 
     def _note_fault(self, kind: str, counter: Optional[str] = None) -> None:
         """Record a wire-tier detection: fault ring entry (+ optional
-        counter) — the observables the chaos contract verifies."""
+        counter) — the observables the chaos contract verifies.  With a
+        flight recorder mounted (obs/flight.py) every ring entry also
+        triggers a debounced black-box dump, checkpoint-corruption
+        rejections included (the store's fault hook routes here)."""
         if counter is not None:
             self.metrics.counter(counter).inc()
         self.fault_log.append((self.uid.bytes.hex()[:8], WireFault(kind)))
+        if self.flight is not None:
+            self.flight.note_fault(kind)
 
     async def _on_incoming(self, reader, writer) -> None:
         addr = writer.get_extra_info("peername") or ("?", 0)
@@ -903,7 +940,10 @@ class Hydrabadger:
                 depth = q
         m.gauge("peer_send_queue_depth").track(depth)
         if self.obs.enabled:
-            self.obs.stamp(_time.time())
+            # the node's wall clock (wall_now), not time.time(): with
+            # injected skew the trace must carry the skewed stamps the
+            # aggregator aligns, not a secretly honest clock
+            self.obs.stamp(self.wall_now())
 
     def _preverify_batch(self, batch: List[tuple]) -> None:
         """Amortised wire-signature checks (SURVEY.md §7 hard part 3).

@@ -24,11 +24,15 @@ semantic surface, our own encoding):
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import time
 from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
 from ..crypto.threshold import PublicKey, SecretKey, Signature
-from ..obs.metrics import BYTES_RX_TOTAL, BYTES_TX_TOTAL
+from ..obs.aggregate import consensus_tags
+from ..obs.metrics import BYTES_RX_BY_KIND_PREFIX, BYTES_RX_TOTAL, BYTES_TX_TOTAL
+from ..obs.recorder import NULL_RECORDER
 from ..utils import codec
 from ..utils.ids import Uid
 
@@ -126,15 +130,59 @@ class WireStream:
         # owner (Hydrabadger._new_stream assigns its registry) — ONE
         # wiring path, chaos subclass included
         self.metrics = None
+        # cluster-timeline correlation (round 14): with tracing on, the
+        # stream stamps a wire_tx event as each frame is built and a
+        # wire_rx event as each frame is read — tagged (node via the
+        # bound recorder, peer uid, kind, frame digest as the message
+        # id, plus era/epoch/instance for consensus payloads) so the
+        # aggregator reconstructs per-message network latency and
+        # cross-node stage ordering.  Events go straight into the
+        # stamped ring (emit_stamped) on THIS node's clock — wired by
+        # _new_stream alongside metrics; inert on the null recorder.
+        self.obs = NULL_RECORDER
+        self.clock = time.time
+
+    def _peer_hex(self) -> str:
+        return self.peer_uid.hex()[:8] if self.peer_uid else "?"
+
+    def _wire_tags(self, msg: WireMessage) -> dict:
+        """(era, epoch, instance, inner kind) for consensus payloads —
+        best-effort, trace-path only.  The nested message sits at a
+        different payload slot per kind: ``message`` is (src, payload),
+        ``key_gen`` is (src, instance_id, payload)."""
+        try:
+            if msg.kind == "message":
+                return consensus_tags(msg.payload[1])
+            if msg.kind == "key_gen":
+                return consensus_tags(msg.payload[2])
+        except (TypeError, IndexError):
+            pass
+        return {}
 
     def _frame(self, msg: WireMessage) -> bytes:
         """Sign + length-prefix one message into its on-wire bytes.
         Factored from send() so fault-injecting streams (net/chaos.py)
         can build — and tamper with — a frame without re-implementing
-        the codec/signing contract."""
+        the codec/signing contract.  The wire_tx trace event is stamped
+        here so the chaos plane's own send path (which frames, then
+        delays/duplicates) is covered too."""
         body = msg.encode()
         sig = self.secret_key.sign(body).to_bytes() if self.sign_frames else b""
-        return self._assemble(body, sig)
+        frame = self._assemble(body, sig)
+        if self.obs.enabled:
+            # the frame digest is the message id: per-connection FIFO
+            # makes a sequence number ambiguous the moment the chaos
+            # plane reorders, the digest pairs exactly
+            self.obs.emit_stamped(
+                "wire_tx",
+                self.clock(),
+                dst=self._peer_hex(),
+                kind=msg.kind,
+                mid=hashlib.sha256(frame).hexdigest()[:16],
+                frame_bytes=len(frame),
+                **self._wire_tags(msg),
+            )
+        return frame
 
     @staticmethod
     def _assemble(body: bytes, sig: bytes) -> bytes:
@@ -170,6 +218,22 @@ class WireStream:
             self.metrics.counter(BYTES_RX_TOTAL).inc(4 + length)
         body, sig_bytes = codec.decode(frame)
         msg = WireMessage.decode(bytes(body))
+        if self.metrics is not None:
+            # per-kind byte attribution (round 14): name space bounded
+            # by wire.KINDS — decode above rejects anything else
+            self.metrics.counter(BYTES_RX_BY_KIND_PREFIX + msg.kind).inc(
+                4 + length
+            )
+        if self.obs.enabled:
+            self.obs.emit_stamped(
+                "wire_rx",
+                self.clock(),
+                src=self._peer_hex(),
+                kind=msg.kind,
+                mid=hashlib.sha256(header + frame).hexdigest()[:16],
+                frame_bytes=4 + length,
+                **self._wire_tags(msg),
+            )
         return msg, bytes(body), bytes(sig_bytes)
 
     def verify(self, body: bytes, sig_bytes: bytes) -> bool:

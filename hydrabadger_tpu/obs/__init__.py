@@ -29,6 +29,17 @@ contract splits everything else:
     of ad-hoc ``HYDRABADGER_LOG`` parsing in ``__main__``; levels and
     per-module filters are preserved, and warning+ records can mirror
     into a recorder as instant events.
+  * ``obs.aggregate`` — the CLUSTER timeline (round 14): merges every
+    node's trace/flight/batch-log feeds into one perfetto-loadable
+    timeline with committed-batch clock alignment (injected skew/drift
+    corrected, mixed clock domains refused unless aligned), and
+    attributes each committed epoch's critical path — the straggler
+    node and its gating stage (RBC/BA/subset/tdec/DKG-settle) — plus
+    wire-event message latency p50/p99.
+  * ``obs.flight`` — bounded per-node flight recorder dumped atomically
+    (generational, digest-checked) on fault-ring entries / SIGTERM /
+    checkpoint-corruption rejection, so every chaos run leaves a black
+    box a SIGKILL cannot retract.
 
 Secrets can never enter a trace: lint's secret-taint pass treats every
 obs emitter as a logging sink (lint/registry.py:OBS_EMIT_NAMES), so a

@@ -19,22 +19,62 @@ event's ``name``/``ph``/``ts`` (microseconds)/``args``.  Track layout:
 
 Unstamped events (still pending at export time) are skipped: a span
 that never reached an I/O boundary never became externally visible.
+
+Traces carry a **clock-domain header** (round 14): the stamping
+boundaries use different clocks (the sim router stamps
+``perf_counter``, the TCP handler poll a — possibly skewed — wall
+clock), so a JSONL dump's first line is a ``trace_meta`` metadata
+record declaring the domain, and :func:`require_uniform_domain` is the
+merge gate: combining feeds from different domains without anchor
+alignment raises :class:`ClockDomainMismatch` instead of silently
+interleaving timelines with unrelated origins.
 """
 from __future__ import annotations
 
 import json
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional, Tuple
 
-from .recorder import Event
+from .recorder import DOMAIN_UNSPECIFIED, Event
 
 # stable thread ordering for the known stages; unknown names follow
 _STAGE_ORDER = ("epoch", "rbc", "ba", "subset", "tdec")
 
+TRACE_META = "trace_meta"
 
-def write_jsonl(events: Iterable[Event], path: str) -> int:
-    """One JSON object per line; returns the number written."""
+
+class ClockDomainMismatch(ValueError):
+    """Feeds from different clock domains offered for an unaligned
+    merge — perf_counter origins are arbitrary per process, so the
+    merge would be silently meaningless."""
+
+
+def require_uniform_domain(domains: Iterable[Optional[str]]) -> str:
+    """The merge gate: every feed must declare the SAME clock domain
+    (unspecified counts as its own domain).  Returns the common domain;
+    raises :class:`ClockDomainMismatch` otherwise.  Aggregators that
+    can align feeds from committed-batch anchors (obs/aggregate.py)
+    catch this and align instead — mixing is allowed only loudly."""
+    seen = {d or DOMAIN_UNSPECIFIED for d in domains}
+    if len(seen) > 1:
+        raise ClockDomainMismatch(
+            "refusing to merge traces from mixed clock domains "
+            f"{sorted(seen)} without anchor alignment"
+        )
+    return next(iter(seen)) if seen else DOMAIN_UNSPECIFIED
+
+
+def write_jsonl(
+    events: Iterable[Event], path: str, meta: Optional[dict] = None
+) -> int:
+    """One JSON object per line; returns the number written.  ``meta``
+    (clock_domain, node, pid…) becomes a leading ``trace_meta``
+    metadata line the readers surface separately from events."""
     n = 0
     with open(path, "w") as fh:
+        if meta is not None:
+            fh.write(
+                json.dumps({"name": TRACE_META, "ph": "M", **meta}) + "\n"
+            )
         for ev in events:
             if ev.t is None:
                 continue
@@ -44,6 +84,13 @@ def write_jsonl(events: Iterable[Event], path: str) -> int:
 
 
 def read_jsonl(path: str) -> List[Event]:
+    return read_feed(path)[1]
+
+
+def read_feed(path: str) -> Tuple[dict, List[Event]]:
+    """Read one JSONL trace: (meta, events).  Metadata records ("M"
+    phase) fold into meta; events keep their order."""
+    meta: dict = {}
     out: List[Event] = []
     with open(path) as fh:
         for line in fh:
@@ -51,6 +98,12 @@ def read_jsonl(path: str) -> List[Event]:
             if not line:
                 continue
             d = json.loads(line)
+            if d.get("ph") == "M":
+                if d.get("name") == TRACE_META:
+                    meta.update(
+                        {k: v for k, v in d.items() if k not in ("name", "ph")}
+                    )
+                continue
             out.append(
                 Event(
                     name=d.pop("name"),
@@ -59,7 +112,7 @@ def read_jsonl(path: str) -> List[Event]:
                     attrs=d,
                 )
             )
-    return out
+    return meta, out
 
 
 def chrome_trace_events(events: Iterable[Event]) -> List[dict]:
@@ -115,11 +168,18 @@ def chrome_trace_events(events: Iterable[Event]) -> List[dict]:
     return out
 
 
-def write_chrome_trace(events: Iterable[Event], path: str) -> int:
-    """Perfetto-loadable dump; returns the non-metadata event count."""
+def write_chrome_trace(
+    events: Iterable[Event], path: str, meta: Optional[dict] = None
+) -> int:
+    """Perfetto-loadable dump; returns the non-metadata event count.
+    ``meta`` rides the top-level ``metadata`` key (clock domain,
+    alignment report) — Perfetto ignores unknown keys."""
     recs = chrome_trace_events(events)
+    doc = {"traceEvents": recs, "displayTimeUnit": "ms"}
+    if meta is not None:
+        doc["metadata"] = meta
     with open(path, "w") as fh:
-        json.dump({"traceEvents": recs, "displayTimeUnit": "ms"}, fh)
+        json.dump(doc, fh)
     return sum(1 for r in recs if r["ph"] != "M")
 
 

@@ -21,7 +21,6 @@ from __future__ import annotations
 import logging
 import os
 import sys
-import time
 from typing import Optional
 
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
@@ -82,15 +81,20 @@ class _RecorderHandler(logging.Handler):
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
-            self._recorder.instant(
+            # logging is an I/O boundary with its own moment: the event
+            # goes straight into the stamped ring on the RECORDER'S
+            # clock (its declared domain, incl. any injected node
+            # skew).  Routing through stamp() here would flush the
+            # consensus cores' pending events early with the log
+            # record's time — and on the wrong clock domain when the
+            # recorder stamps perf_counter (sim/router.py).
+            self._recorder.emit_stamped(
                 "log",
+                None,
                 level=record.levelname,
                 logger=record.name,
                 message=record.getMessage(),
             )
-            # logging is an I/O boundary: stamp immediately so the event
-            # carries the moment the record was rendered
-            self._recorder.stamp(time.time())
         except Exception:  # pragma: no cover - never break the app on obs
             pass
 

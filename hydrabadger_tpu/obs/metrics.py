@@ -135,6 +135,16 @@ BYTES_TX_TOTAL = "bytes_tx_total"
 BYTES_RX_TOTAL = "bytes_rx_total"
 BYTES_PER_EPOCH = "bytes_per_epoch"
 
+# Per-kind byte attribution (round 14): the totals above say HOW MUCH
+# rode the wire, these say ON WHAT.  The prefix is suffixed by a
+# ``net/wire.py:KINDS`` member (WireMessage.decode enforces membership
+# before the counter is minted), so the name space stays bounded by the
+# fixed wire vocabulary even when the VOLUME is attacker-paced — the
+# discipline of the wire_rx_* counters, applied to bytes.  The low-comm
+# RBC byte cut (bench config 14) is attributable per kind through these
+# (sim tier: the router's consensus-kind ledger, Router.bytes_rx_by_kind).
+BYTES_RX_BY_KIND_PREFIX = "bytes_rx_by_kind_"
+
 WIRE_SIG_REJECTED = "wire_sig_rejected"
 WIRE_FRONTIER_REJECTED = "wire_frontier_rejected"
 WIRE_SRC_SPOOF = "wire_src_spoof"

@@ -27,9 +27,10 @@ the hot paths cost one attribute lookup and an empty call.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 # Span phases follow the Chrome trace-event vocabulary so the exporter
 # is a dumb mapping: B(egin)/E(nd) bracket a duration, "i" is an
@@ -44,6 +45,23 @@ PHASE_COUNTER = "C"
 # the oldest (never the newest — a trace should end at the interesting
 # part, the present).
 DEFAULT_CAPACITY = 1 << 18
+
+# Clock domains (round 14, the cluster timeline plane).  Every recorder
+# declares which clock its stamping boundary uses, the exporters write
+# the domain into the trace header, and the aggregator refuses to mix
+# domains silently: a perf_counter trace (arbitrary origin) merged with
+# a wall-clock trace without anchor alignment would interleave events
+# separated by decades.  "wall" may be a SKEWED wall (the process-tier
+# chaos harness injects per-node offset/drift) — the aggregator
+# corrects it from committed-batch anchors rather than trusting it.
+DOMAIN_WALL = "wall"
+DOMAIN_PERF = "perf_counter"
+DOMAIN_UNSPECIFIED = "unspecified"
+
+
+def domain_clock(domain: str) -> Callable[[], float]:
+    """The reader for a declared clock domain (unknown -> wall)."""
+    return time.perf_counter if domain == DOMAIN_PERF else time.time
 
 
 @dataclass
@@ -65,13 +83,40 @@ class Recorder:
 
     enabled = True
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        clock: Optional[Callable[[], float]] = None,
+        clock_domain: str = DOMAIN_UNSPECIFIED,
+    ):
         self.events: Deque[Event] = deque(maxlen=capacity)
         self._pending: List[Event] = []
         # pending is bounded too: a core driven forever between stamps
         # (a broken harness) must not grow host memory; overflow drops
         # the OLDEST pending events, mirroring the ring
         self._pending_cap = capacity
+        # the clock THIS recorder's boundary-stamped events live on.
+        # ``clock`` is for emit_stamped() callers without their own
+        # clock (the logging mirror); harnesses with a node-local
+        # skewed clock override it (net CLI: node.wall_now)
+        self.clock_domain = clock_domain
+        self.clock = clock or domain_clock(clock_domain)
+
+    def __getstate__(self):
+        """Picklable (sim checkpoints hold the owning SimNetwork's
+        recorder): the clock callable may be a harness-bound method —
+        recreated from the declared domain on load instead."""
+        state = self.__dict__.copy()
+        state.pop("clock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.__dict__.setdefault("clock_domain", DOMAIN_UNSPECIFIED)
+        self.clock = domain_clock(self.clock_domain)
+
+    def now(self) -> float:
+        return self.clock()
 
     # -- emission (core side: no clocks) ------------------------------------
 
@@ -91,6 +136,20 @@ class Recorder:
 
     def counter(self, name: str, value, **attrs) -> None:
         self.emit(name, PHASE_COUNTER, value=value, **attrs)
+
+    def emit_stamped(
+        self, name: str, t: Optional[float] = None,
+        phase: str = PHASE_INSTANT, **attrs
+    ) -> None:
+        """Emit an already-timed event straight into the stamped ring,
+        BYPASSING the pending buffer.  For I/O boundaries that both
+        observe and time an effect themselves (a socket write, a log
+        record) — routing these through emit()+stamp() would flush the
+        consensus cores' pending events early with the wrong moment.
+        ``t=None`` reads this recorder's own clock."""
+        self.events.append(
+            Event(name, phase, attrs, self.clock() if t is None else t)
+        )
 
     # -- stamping (I/O-boundary side: owns the clock) -----------------------
 
@@ -144,11 +203,21 @@ class BoundRecorder:
     def counter(self, name: str, value, **attrs) -> None:
         self.emit(name, PHASE_COUNTER, value=value, **attrs)
 
+    def emit_stamped(
+        self, name: str, t: Optional[float] = None,
+        phase: str = PHASE_INSTANT, **attrs
+    ) -> None:
+        self._rec.emit_stamped(name, t, phase, **{**self._attrs, **attrs})
+
     def bind(self, **attrs) -> "BoundRecorder":
         return BoundRecorder(self._rec, {**self._attrs, **attrs})
 
     def stamp(self, t: float) -> int:
         return self._rec.stamp(t)
+
+    @property
+    def clock_domain(self) -> str:
+        return self._rec.clock_domain
 
 
 class NullRecorder:
@@ -156,8 +225,15 @@ class NullRecorder:
     same singleton — the zero-overhead default wired everywhere."""
 
     enabled = False
+    clock_domain = DOMAIN_UNSPECIFIED
 
     def emit(self, name: str, phase: str = PHASE_INSTANT, **attrs) -> None:
+        pass
+
+    def emit_stamped(
+        self, name: str, t: Optional[float] = None,
+        phase: str = PHASE_INSTANT, **attrs
+    ) -> None:
         pass
 
     def begin(self, name: str, **attrs) -> None:

@@ -251,10 +251,15 @@ def main(argv=None) -> int:
     if args.trace:
         from ..obs import export as obs_export
 
+        meta = {"clock_domain": net.recorder.clock_domain}
         if args.trace.endswith(".jsonl"):
-            n = obs_export.write_jsonl(net.recorder.events, args.trace)
+            n = obs_export.write_jsonl(
+                net.recorder.events, args.trace, meta=meta
+            )
         else:
-            n = obs_export.write_chrome_trace(net.recorder.events, args.trace)
+            n = obs_export.write_chrome_trace(
+                net.recorder.events, args.trace, meta=meta
+            )
         print(f"trace: {n} events -> {args.trace}", file=sys.stderr)
     if args.metrics:
         from ..obs.metrics import default_registry

@@ -18,7 +18,7 @@ import random
 import time
 from collections import OrderedDict
 from contextlib import contextmanager
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ..consensus.dynamic_honey_badger import DynamicHoneyBadger
@@ -87,6 +87,19 @@ class SimConfig:
     # SimNetwork.recorder; the router stamps them at each delivery.
     # Off by default — the null recorder keeps the hooks ~free.
     trace: bool = False
+    # cluster-timeline wire events (round 14): with trace on, stamp a
+    # wire_tx/wire_rx event per router enqueue/delivery (seq-paired, so
+    # per-message latency is reconstructable).  False keeps span
+    # tracing without the per-message stamps — the bench config-15
+    # control leg that prices the stamps alone.
+    trace_wire: bool = True
+    # sampling stride for the router wire events: every Nth enqueue is
+    # stamped (deterministic by seq, so a sampled tx always has its
+    # sampled rx).  The fast tier's ~30k msgs/epoch make exhaustive
+    # stamping cost ~30% epochs/s; 1-in-32 (~1k sampled pairs per fast
+    # epoch) holds the config-15 <5% budget.  Set 1 for exhaustive
+    # pairing on small runs.
+    trace_wire_sample: int = 32
     # reliable-broadcast variant (consensus/broadcast.py VARIANTS):
     # None = resolve via HYDRABADGER_RBC, default "bracha".  "lowcomm"
     # selects the reduced-communication RBC (echoes carry bare shards
@@ -138,6 +151,10 @@ class SimMetrics:
     # bandwidth (router-metered; zero unless SimConfig.meter_bytes)
     bytes_tx_total: int = 0
     bytes_rx_total: int = 0
+    # per-kind rx attribution (round 14): innermost consensus kind ->
+    # bytes — the ledger that pins WHICH tier the low-comm RBC cut
+    # came from (bounded by the router's RX_KIND_CAP)
+    bytes_rx_by_kind: Dict[str, int] = field(default_factory=dict)
     # per-epoch wall-time percentiles, ms (SURVEY.md §5.5: batch latency
     # as a first-class sim output; the reference only logs)
     latency_p50_ms: float = 0.0
@@ -178,6 +195,7 @@ class SimMetrics:
             "faults": self.faults,
             "bytes_tx_total": self.bytes_tx_total,
             "bytes_rx_total": self.bytes_rx_total,
+            "bytes_rx_by_kind": dict(sorted(self.bytes_rx_by_kind.items())),
             "bytes_per_epoch": round(self.bytes_per_epoch, 1),
             "latency_p50_ms": round(self.latency_p50_ms, 3),
             "latency_p90_ms": round(self.latency_p90_ms, 3),
@@ -216,8 +234,15 @@ class SimNetwork:
             getattr(cfg, "rbc_variant", None)
         )
         # one shared recorder, bound per node so spans carry identity;
-        # one shared registry (the sim is one process, unlike TCP)
-        self.recorder = Recorder() if getattr(cfg, "trace", False) else NULL_RECORDER
+        # one shared registry (the sim is one process, unlike TCP).
+        # The sim's stamping boundaries (router delivery, epoch tick)
+        # read perf_counter — declared so the aggregator never silently
+        # merges this trace with a wall-clock one (obs/export.py)
+        self.recorder = (
+            Recorder(clock=time.perf_counter, clock_domain="perf_counter")
+            if getattr(cfg, "trace", False)
+            else NULL_RECORDER
+        )
         self.metrics = MetricsRegistry()
         if cfg.protocol == "qhb":
             self.nodes: Dict = {
@@ -302,6 +327,8 @@ class SimNetwork:
             recorder=self.recorder,
             metrics=self.metrics,
             meter_bytes=getattr(cfg, "meter_bytes", False),
+            wire_events=getattr(cfg, "trace_wire", True),
+            wire_sample=getattr(cfg, "trace_wire_sample", 32),
         )
         # hbasync tick boundary: the router settles in-flight device
         # work at each quiescence, so completions submitted during a
@@ -405,12 +432,17 @@ class SimNetwork:
             # world has no message plane to meter, so a metered run must
             # travel the real one
             and not getattr(cfg, "meter_bytes", False)
+            # tracing wants the consensus spans + wire events the
+            # native world never emits: a traced run silently recording
+            # ZERO events is worse than a slower traced run, so the
+            # fast path yields to the recorder
+            and not self.recorder.enabled
         )
         if cfg.native_acs is True:
             if not ok:
                 raise ValueError(
                     "native_acs=True requires fast tier, hash coin, "
-                    "no adversary"
+                    "no adversary, no byte metering, no tracing"
                 )
             from . import native_acs
 
@@ -559,6 +591,18 @@ class SimNetwork:
             "device_overlap_has_device": 1 if backend in ("tpu", "gpu") else 0,
         }
 
+    def timeline_report(self) -> Optional[dict]:
+        """Cluster-timeline summary of this run's trace (round 14):
+        per-epoch critical path (straggler node + gating stage) and
+        wire-event message latency, computed by obs/aggregate over the
+        shared recorder.  None when tracing is off.  The sim shares one
+        clock, so no alignment pass runs."""
+        if not self.recorder.enabled:
+            return None
+        from ..obs.aggregate import aggregate_events
+
+        return aggregate_events(list(self.recorder.events))
+
     def _drain_async(self) -> None:
         """Tick-boundary drain of the hbasync plane: settle every
         node's in-flight crypto (completions submitted during this
@@ -624,6 +668,7 @@ class SimNetwork:
         m.faults = len(self.router.faults)
         m.bytes_tx_total = getattr(self.router, "bytes_tx", 0)
         m.bytes_rx_total = getattr(self.router, "bytes_rx", 0)
+        m.bytes_rx_by_kind = dict(getattr(self.router, "bytes_rx_by_kind", {}))
         # progress/agreement are judged over the HONEST nodes: a
         # Byzantine wrapper's core is honest underneath, but liveness-
         # under-attack is a claim about what the honest quorum commits

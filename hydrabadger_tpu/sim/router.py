@@ -15,6 +15,7 @@ from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple, TypeVar
 
 from ..consensus.types import Step
+from ..obs.aggregate import consensus_tags
 from ..obs.recorder import resolve as _resolve_recorder
 
 N = TypeVar("N", bound=Hashable)
@@ -41,6 +42,8 @@ class Router:
         recorder=None,
         metrics=None,
         meter_bytes: bool = False,
+        wire_events: bool = True,
+        wire_sample: int = 32,
     ):
         self.node_ids = list(node_ids)
         self.handle = handle  # (our_id, sender, message) -> Step
@@ -60,6 +63,29 @@ class Router:
         self.meter_bytes = meter_bytes
         self.bytes_tx = 0
         self.bytes_rx = 0
+        # per-kind rx byte attribution (round 14): innermost consensus
+        # kind -> bytes, so the low-comm RBC cut is attributable to the
+        # echo tier specifically.  Bounded: kinds come from the cores'
+        # fixed protocol vocabulary; anything past the cap folds into
+        # "other" so adversary-minted shapes cannot grow the dict.
+        self.bytes_rx_by_kind: Dict[str, int] = {}
+        # wire-event sequence for the cluster-timeline plane: assigned
+        # at enqueue, carried with the queue entry, echoed by the rx
+        # event — exact tx/rx pairing even under shuffle delivery.
+        # wire_events=False keeps span tracing while skipping the
+        # per-message tx/rx stamps (the bench config-15 control leg).
+        # wire_sample=N stamps every Nth enqueue (seq-deterministic, so
+        # the sampled tx always has its sampled rx): the sim's fast
+        # tier pushes ~30k messages/epoch and a per-message Python
+        # event would cost ~30% epochs/s — 1-in-32 (~1k sampled pairs
+        # per fast epoch) keeps the stamps under the 5% budget (bench
+        # config 15) while the latency percentiles stay statistically
+        # faithful.  =1 for exhaustive pairing; the TCP tier's
+        # WireStream never samples (frame rates are orders of
+        # magnitude lower).
+        self._wire_seq = 0
+        self.wire_events = wire_events
+        self.wire_sample = max(1, int(wire_sample))
         # id -> (message, size): identity-keyed, HOLDING the message so
         # its id cannot be recycled while cached (a bare id key could
         # alias a freed tuple's reused address and price a different
@@ -104,6 +130,10 @@ class Router:
         self.__dict__.setdefault("bytes_tx", 0)
         self.__dict__.setdefault("bytes_rx", 0)
         self.__dict__.setdefault("_size_cache", OrderedDict())
+        self.__dict__.setdefault("bytes_rx_by_kind", {})
+        self.__dict__.setdefault("_wire_seq", 0)
+        self.__dict__.setdefault("wire_events", True)
+        self.__dict__.setdefault("wire_sample", 32)
 
     def _msg_size(self, message) -> int:
         """Canonical wire size of a sim message (codec encoding — the
@@ -142,6 +172,15 @@ class Router:
     # core or an amplifying adversary schedule enqueueing faster than
     # deliver_one drains.  Fail loudly instead of filling host memory.
     MAX_QUEUE = 4_000_000
+
+    # per-kind rx ledger cap: the cores' kind vocabulary is ~a dozen
+    # tokens; 64 leaves slack, overflow folds into "other"
+    RX_KIND_CAP = 64
+
+    def _msg_kind(self, message) -> str:
+        """Innermost consensus kind of a sim message (bc_echo, ba,
+        dec_share, part…) for the per-kind byte ledger."""
+        return str(consensus_tags(message).get("ckind", "other"))
 
     def _enqueue(self, sender, recipient, message) -> None:
         if self.meter_bytes:
@@ -184,6 +223,30 @@ class Router:
                         )
                 self.queue.extend(replacement)
                 return
+        if self.obs.enabled and self.wire_events:
+            # cluster-timeline wire event: the enqueue IS the sim's tx
+            # boundary.  Stamped directly (emit_stamped) — routing it
+            # through the pending buffer would mis-stamp it with the
+            # NEXT delivery's clock.  The seq AND the extracted tags
+            # ride the queue entry so the rx event pairs exactly even
+            # under shuffle and the nested-tuple walk runs once per
+            # message, not once per side.  Unsampled messages pay one
+            # increment + modulo.
+            self._wire_seq += 1
+            seq = self._wire_seq
+            if seq % self.wire_sample == 0:
+                tags = consensus_tags(message)
+                self.obs.emit_stamped(
+                    "wire_tx",
+                    time.perf_counter(),
+                    node=sender,
+                    dst=recipient,
+                    kind="message",
+                    mid=seq,
+                    **tags,
+                )
+                self.queue.append((sender, recipient, message, seq, tags))
+                return
         self.queue.append((sender, recipient, message))
 
     def deliver_one(self) -> bool:
@@ -200,9 +263,33 @@ class Router:
                 self.queue[idx] = last
         else:
             item = self.queue.popleft()
-        sender, recipient, message = item
+        # entries are (sender, recipient, message[, seq, tags]): the
+        # seq/tags ride only traced enqueues; adversary-injected and
+        # checkpoint-era entries stay 3-tuples
+        sender, recipient, message = item[0], item[1], item[2]
         if self.meter_bytes:
-            self.bytes_rx += self._msg_size(message)
+            size = self._msg_size(message)
+            self.bytes_rx += size
+            kind = self._msg_kind(message)
+            if kind not in self.bytes_rx_by_kind and (
+                len(self.bytes_rx_by_kind) >= self.RX_KIND_CAP
+            ):
+                kind = "other"
+            self.bytes_rx_by_kind[kind] = (
+                self.bytes_rx_by_kind.get(kind, 0) + size
+            )
+        if self.obs.enabled and self.wire_events and len(item) > 3:
+            # only sampled enqueues carry a seq: the rx event mirrors
+            # exactly the tx events that exist
+            self.obs.emit_stamped(
+                "wire_rx",
+                time.perf_counter(),
+                node=recipient,
+                src=sender,
+                kind="message",
+                mid=item[3],
+                **item[4],
+            )
         step = self.handle(recipient, sender, message)
         self.delivered += 1
         if step is not None:

@@ -303,7 +303,8 @@ def wire_chaos_soak(epochs: int = 8) -> Dict:
 
 
 def process_chaos_soak(epochs: int = 6,
-                       rss_budget_mb: float = 64.0) -> Dict:
+                       rss_budget_mb: float = 64.0,
+                       workdir: str = None) -> Dict:
     """Process-tier chaos gate (ROADMAP item 3's process-runner half):
     a 4-node cluster of REAL OS processes (``python -m hydrabadger_tpu``
     per validator) bootstraps over real sockets, one validator takes a
@@ -316,14 +317,20 @@ def process_chaos_soak(epochs: int = 6,
     robustness metrics: commit gap under a real kill, recovery
     catch-up seconds, and the supervisor's own flat-RSS check (the
     feeds are files, so the supervisor must stay O(1) in memory no
-    matter how long the children run)."""
+    matter how long the children run).  Round 14: the run's feeds are
+    additionally merged by ``obs.aggregate`` inside the harness — the
+    row carries the cluster-timeline fields (epoch_critical_stage /
+    straggler_node / msg_latency_p99_s, clock fits, flight-dump
+    census), and a kill whose flight black box went missing fails.
+    ``workdir`` pins the artifact directory (the scripts/test-all
+    aggregate gate re-runs ``obs.aggregate`` over it)."""
     from ..net.cluster import run_process_chaos
 
     # deadline UNDER the scripts/test-all external `timeout -k 15 300`:
     # the harness's own diagnostic (health report + graceful child
     # sweep) must fire before the outer kill would orphan anything
     row = run_process_chaos(epochs=epochs, base_port=3990,
-                            deadline_s=240.0)
+                            workdir=workdir, deadline_s=240.0)
     assert row["supervisor_rss_growth_mb"] < rss_budget_mb, (
         f"supervisor RSS grew {row['supervisor_rss_growth_mb']:.1f} MB "
         f"(> {rss_budget_mb})"
@@ -525,6 +532,15 @@ def rbc_soak(epochs: int = 5, n_nodes: int = 16) -> Dict:
         "epochs": epochs,
         "bytes_per_epoch_bracha": round(m_bracha.bytes_per_epoch),
         "bytes_per_epoch_lowcomm": round(m_lc.bytes_per_epoch),
+        # per-kind attribution (round 14): the cut must come from the
+        # echo tier (bc_echo vs bc_echo_lc), not from some accounting
+        # artifact — the ledger shows exactly which kind shrank
+        "bytes_rx_by_kind_bracha": dict(
+            sorted(m_bracha.bytes_rx_by_kind.items())
+        ),
+        "bytes_rx_by_kind_lowcomm": dict(
+            sorted(m_lc.bytes_rx_by_kind.items())
+        ),
         "bytes_reduction": round(
             1 - m_lc.bytes_per_epoch / m_bracha.bytes_per_epoch, 3
         ),
@@ -578,6 +594,12 @@ def main(argv=None) -> int:
                    help="process-chaos tier committed-epoch target "
                    "(counted across the armed window, per surviving "
                    "node)")
+    p.add_argument("--proc-workdir", default=None, metavar="DIR",
+                   help="pin the process-chaos artifact directory "
+                   "(checkpoints, metrics/batch/trace feeds, flight "
+                   "dumps) so the scripts/test-all aggregate gate can "
+                   "run obs.aggregate over it afterwards; default: a "
+                   "fresh tempdir")
     p.add_argument("--rbc-only", action="store_true",
                    help="run ONLY the bandwidth-metered RBC variant "
                    "gate (point-identical batches + bytes/epoch delta "
@@ -621,7 +643,7 @@ def main(argv=None) -> int:
         print(json.dumps(r), flush=True)
         results.append(r)
     if args.proc_only or (not only and not args.skip_proc):
-        r = process_chaos_soak(args.proc_epochs)
+        r = process_chaos_soak(args.proc_epochs, workdir=args.proc_workdir)
         print(json.dumps(r), flush=True)
         results.append(r)
     if not args.skip_tcp and not only:

@@ -1,0 +1,507 @@
+"""Cluster-timeline plane tests (round 14): clock-domain headers and
+the mixed-domain merge refusal, committed-batch clock alignment
+(injected skew/drift recovered), per-epoch critical-path attribution,
+wire tx/rx event pairing and message latency, flight-recorder
+atomicity incl. the torn-dump rejection + generation fallback, and the
+per-kind byte ledger."""
+import json
+import os
+
+import pytest
+
+from hydrabadger_tpu.obs import aggregate as ag
+from hydrabadger_tpu.obs import export as obs_export
+from hydrabadger_tpu.obs import flight as obs_flight
+from hydrabadger_tpu.obs.export import ClockDomainMismatch
+from hydrabadger_tpu.obs.recorder import Recorder
+
+pytestmark = pytest.mark.obs
+
+
+# -- clock-domain headers -----------------------------------------------------
+
+
+def test_trace_meta_header_roundtrip(tmp_path):
+    rec = Recorder(clock_domain="wall")
+    rec.bind(node="n0").instant("epoch_commit", era=0, epoch=1)
+    rec.stamp(5.0)
+    path = str(tmp_path / "t.trace.jsonl")
+    n = obs_export.write_jsonl(
+        rec.events, path, meta={"clock_domain": "wall", "node": "n0"}
+    )
+    meta, events = obs_export.read_feed(path)
+    assert meta["clock_domain"] == "wall"
+    assert meta["node"] == "n0"
+    assert len(events) == n == 1
+    # the meta line is invisible to the plain event reader
+    assert len(obs_export.read_jsonl(path)) == 1
+
+
+def test_require_uniform_domain_refuses_mix():
+    assert obs_export.require_uniform_domain(["wall", "wall"]) == "wall"
+    with pytest.raises(ClockDomainMismatch):
+        obs_export.require_uniform_domain(["wall", "perf_counter"])
+
+
+def _write_feed(tmp_path, name, node, domain, events):
+    rec = Recorder(clock_domain=domain)
+    bound = rec.bind(node=node)
+    for ev_name, t, attrs in events:
+        bound.emit_stamped(ev_name, t, **attrs)
+    obs_export.write_jsonl(
+        rec.events,
+        str(tmp_path / f"{name}.trace.jsonl"),
+        meta={"clock_domain": domain, "node": node},
+    )
+
+
+def test_aggregate_dir_refuses_unanchored_domain_mix(tmp_path):
+    # two feeds, two domains, NO shared committed-batch anchors: the
+    # merge must refuse rather than interleave arbitrary origins
+    _write_feed(
+        tmp_path, "node0", "a", "wall",
+        [("epoch", 100.0, {"ph_": 0})],
+    )
+    _write_feed(
+        tmp_path, "node1", "b", "perf_counter",
+        [("epoch", 3.0, {"ph_": 0})],
+    )
+    with pytest.raises(ClockDomainMismatch):
+        ag.aggregate_dir(str(tmp_path))
+
+
+# -- alignment + critical path over synthetic feeds ---------------------------
+
+
+def _span(rec, name, t0, t1, **attrs):
+    rec.emit_stamped(name, t0, phase="B", **attrs)
+    rec.emit_stamped(name, t1, phase="E", **attrs)
+
+
+# node c straggles on these epochs (out of 0..11).  The lateness must
+# VARY per epoch: committed-batch alignment absorbs any CONSTANT
+# per-node lateness into that node's clock offset by construction (the
+# anchors ARE the commits) — the aggregator attributes per-epoch
+# variation, which is what a straggler investigation needs.  The late
+# epochs sit symmetric around the run's middle so the straggle adds no
+# slope bias to the least-squares clock fit.
+_EPOCHS = 12
+_LATE_EPOCHS = {4, 7}
+_LATE_S = 0.5
+
+
+def _synthetic_cluster(tmp_path, skew_offset=30.0, skew_rate=1.25):
+    """Two honest-clock nodes and one skewed node; node 'c' (skewed)
+    straggles by 0.5 s on two mid-run epochs, gated by tdec."""
+    for node, warp in (("a", None), ("b", None), ("c", (skew_offset, skew_rate))):
+        rec = Recorder(clock_domain="wall")
+        bound = rec.bind(node=node)
+
+        def w(t):
+            if warp is None:
+                return t
+            return warp[1] * t + warp[0]
+
+        for epoch in range(_EPOCHS):
+            base = 1000.0 + epoch * 1.0
+            late = _LATE_S if (node == "c" and epoch in _LATE_EPOCHS) else 0.0
+            _span(bound, "rbc", w(base), w(base + 0.1 + late),
+                  era=0, epoch=epoch, instance=1)
+            _span(bound, "tdec", w(base + 0.1), w(base + 0.3 + late),
+                  era=0, epoch=epoch)
+            _span(bound, "epoch", w(base), w(base + 0.35 + late),
+                  era=0, epoch=epoch)
+            bound.emit_stamped(
+                "epoch_commit", w(base + 0.35 + late),
+                era=0, epoch=epoch + 1,
+            )
+        obs_export.write_jsonl(
+            rec.events,
+            str(tmp_path / f"node{node}.trace.jsonl"),
+            meta={"clock_domain": "wall", "node": node},
+        )
+
+
+def test_alignment_recovers_injected_skew_and_drift(tmp_path):
+    _synthetic_cluster(tmp_path, skew_offset=30.0, skew_rate=1.25)
+    report = ag.aggregate_dir(str(tmp_path))
+    fit = report["clock"]["alignment"]["c"]
+    # the aligner maps the skewed clock BACK: rate ~= 1/1.25 (the
+    # straggle pattern rides the anchors as noise, hence the tolerance)
+    assert fit["rate"] == pytest.approx(1.0 / 1.25, rel=0.02)
+    assert fit["anchors"] >= 2
+    # after alignment the gating stage and the per-epoch stragglers
+    # emerge; on the straggle-free epochs the spread collapses to the
+    # absorbed-mean residual (~ _LATE_S * |late| / _EPOCHS)
+    assert report["epoch_critical_stage"] == "tdec"
+    rows = {r["epoch"]: r for r in report["epochs"]}
+    for epoch in _LATE_EPOCHS:
+        assert rows[epoch]["straggler_node"] == "c"
+        assert rows[epoch]["critical_stage"] == "tdec"
+        # the per-epoch straggle survives alignment (vs the ~30 s raw
+        # skew); its MEAN was absorbed into c's offset, so the aligned
+        # spread is the deviation from that mean, not the full 0.5 s
+        assert 0.3 < rows[epoch]["commit_spread_s"] < _LATE_S
+    for epoch in set(range(_EPOCHS)) - _LATE_EPOCHS:
+        assert rows[epoch]["commit_spread_s"] < 0.15
+
+
+def test_batch_log_rows_anchor_alignment(tmp_path):
+    """Alignment must work from the process tier's batch logs alone —
+    the feed a SIGKILL cannot retract — even when traces carry no
+    epoch_commit instants."""
+    for node, off in (("a", 0.0), ("b", 40.0)):
+        rec = Recorder(clock_domain="wall")
+        bound = rec.bind(node=node)
+        for epoch in range(3):
+            base = 100.0 + epoch + off
+            _span(bound, "ba", base, base + 0.1, era=0, epoch=epoch,
+                  instance=0)
+            _span(bound, "epoch", base, base + 0.2, era=0, epoch=epoch)
+        obs_export.write_jsonl(
+            rec.events,
+            str(tmp_path / f"node{node}.trace.jsonl"),
+            meta={"clock_domain": "wall", "node": node},
+        )
+        with open(tmp_path / f"node{node}.batches.jsonl", "w") as fh:
+            for epoch in range(3):
+                fh.write(json.dumps(
+                    {"t": 100.25 + epoch + off, "epoch": epoch + 1,
+                     "era": 0, "digest": "d"}
+                ) + "\n")
+            # a torn tail: skipped AND counted, never fatal
+            fh.write('{"t": 103.25, "epo')
+    report = ag.aggregate_dir(str(tmp_path))
+    assert report["clock"]["alignment"]["b"]["offset_s"] == pytest.approx(
+        -40.0, abs=0.01
+    )
+    assert report["torn_tail_lines_skipped"] >= 2
+    assert report["epochs_attributed"] >= 3
+
+
+# -- wire events + message latency -------------------------------------------
+
+
+def test_sim_trace_carries_wire_events_and_latency():
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    net = SimNetwork(
+        SimConfig(n_nodes=4, protocol="qhb", epochs=2, seed=3,
+                  native_acs=False, trace=True)
+    )
+    m = net.run()
+    assert m.agreement_ok
+    names = {e.name for e in net.recorder.events}
+    assert "wire_tx" in names and "wire_rx" in names
+    report = net.timeline_report()
+    net.shutdown()
+    assert report["pairs"] > 0
+    assert report["msg_latency_p99_s"] is not None
+    assert report["msg_latency_p99_s"] >= report["msg_latency_p50_s"] >= 0
+    assert report["epochs_attributed"] >= 2
+    assert any(r["critical_stage"] != "unknown" for r in report["epochs"])
+    # wire events carry the correlation tags the tentpole names
+    tx = next(e for e in net.recorder.events if e.name == "wire_tx")
+    assert {"node", "dst", "kind", "mid"} <= set(tx.attrs)
+
+
+def test_consensus_tags_walks_nested_shapes():
+    msg = ("dhb", 2, ("hb", 7, ("cs", ("cs", 3, ("bc_echo", b"x")))))
+    tags = ag.consensus_tags(msg)
+    assert tags == {"era": 2, "epoch": 7, "instance": 3, "ckind": "bc_echo"}
+    assert ag.consensus_tags(("hb", 1, ("td", 2, ("td_share", b"s")))) == {
+        "epoch": 1, "instance": 2, "ckind": "td_share"
+    }
+    assert ag.consensus_tags(b"opaque") == {}
+
+
+def test_tcp_wire_stream_stamps_tx_rx(tmp_path):
+    """The real socket boundary: tx stamped at frame build, rx at frame
+    read, digest-paired — exact even when frames repeat."""
+    import asyncio
+
+    from hydrabadger_tpu.crypto.threshold import SecretKey
+    from hydrabadger_tpu.net import wire
+
+    tx_uid = b"\x01" * 16
+    rx_uid = b"\x02" * 16
+
+    async def run():
+        import random
+
+        rec = Recorder(clock_domain="wall")
+        done = asyncio.Event()
+
+        async def on_conn(reader, writer):
+            s = wire.WireStream(
+                reader, writer, SecretKey.random(random.Random(2)),
+                sign_frames=False,
+            )
+            # what Peer.establish installs after the handshake: the
+            # authenticated peer uid the rx event attributes src to
+            s.peer_uid = tx_uid
+            s.obs = rec.bind(node=rx_uid.hex()[:8])
+            await s.recv()
+            await s.recv()
+            done.set()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        tx = wire.WireStream(
+            reader, writer, SecretKey.random(random.Random(1)),
+            sign_frames=False,
+        )
+        tx.peer_uid = rx_uid
+        tx.obs = rec.bind(node=tx_uid.hex()[:8])
+        await tx.send(wire.ping())
+        await tx.send(wire.ping())  # identical frame: digest repeats, FIFO pairs
+        await asyncio.wait_for(done.wait(), 5)
+        tx.close()
+        server.close()
+        await server.wait_closed()
+        return rec
+
+    rec = asyncio.run(run())
+    txs = [e for e in rec.events if e.name == "wire_tx"]
+    rxs = [e for e in rec.events if e.name == "wire_rx"]
+    assert len(txs) == 2 and len(rxs) == 2
+    assert txs[0].attrs["mid"] == rxs[0].attrs["mid"]
+    assert txs[0].attrs["kind"] == "ping"
+    assert txs[0].attrs["dst"] == rx_uid.hex()[:8]
+    assert rxs[0].attrs["src"] == tx_uid.hex()[:8]
+    lat = ag.message_latency(list(rec.events))
+    assert lat["pairs"] == 2
+    assert lat["msg_latency_p99_s"] >= 0
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def _make_flight(tmp_path, node="n0"):
+    rec = Recorder(clock_domain="wall")
+    rec.bind(node=node).emit_stamped("epoch_commit", 1.0, era=0, epoch=1)
+    from collections import deque
+
+    from hydrabadger_tpu.net.node import WireFault
+
+    ring = deque([(node, WireFault("wire: bad signature"))])
+    return obs_flight.FlightRecorder(
+        str(tmp_path / f"{node}.flight"), node=node, recorder=rec,
+        fault_ring=ring, min_interval_s=0.0,
+    )
+
+
+def test_flight_dump_roundtrip_and_rotation(tmp_path):
+    fr = _make_flight(tmp_path)
+    path = fr.dump("fault:test")
+    assert path and os.path.exists(path)
+    payload = obs_flight.load_flight(path)
+    assert payload["node"] == "n0"
+    assert payload["reason"] == "fault:test"
+    assert payload["faults"] == ["wire: bad signature"]
+    assert payload["events"] and payload["events"][0]["name"] == "epoch_commit"
+    # second dump rotates the first to .1
+    fr.dump("stop")
+    assert os.path.exists(path + ".1")
+    assert obs_flight.load_flight(path)["reason"] == "stop"
+    assert obs_flight.load_flight(path + ".1")["reason"] == "fault:test"
+
+
+def test_torn_flight_dump_rejected_with_generation_fallback(tmp_path):
+    """The satellite pin: a dump interrupted mid-write (SIGKILL
+    emulation: truncated bytes) must be rejected LOUDLY and the
+    aggregator must fall back to the previous generation — mirroring
+    CheckpointStore semantics."""
+    fr = _make_flight(tmp_path)
+    path = fr.dump("first")
+    fr.dump("second")
+    # SIGKILL mid-write: truncate the newest generation
+    raw = open(path).read()
+    with open(path, "w") as fh:
+        fh.write(raw[: len(raw) // 2])
+    with pytest.raises(obs_flight.FlightCorrupt):
+        obs_flight.load_flight(path)
+    payload, rejected = obs_flight.load_flight_with_fallback(path)
+    assert payload is not None and payload["reason"] == "first"
+    assert rejected == [path]
+    # bit-flip corruption fails the digest the same way
+    fr2 = _make_flight(tmp_path, node="n1")
+    p2 = fr2.dump("only")
+    doc = json.load(open(p2))
+    doc["flight"]["reason"] = "forged"
+    json.dump(doc, open(p2, "w"))
+    with pytest.raises(obs_flight.FlightCorrupt):
+        obs_flight.load_flight(p2)
+    payload, rejected = obs_flight.load_flight_with_fallback(p2)
+    assert payload is None and rejected == [p2]
+
+
+def test_aggregate_dir_surfaces_flight_rejection(tmp_path):
+    """End to end: a torn newest generation is REPORTED (rejected list)
+    while the fallback generation's events still merge."""
+    _synthetic_cluster(tmp_path)
+    fr = _make_flight(tmp_path, node="a")
+    path = fr.dump("first")
+    fr.dump("second")
+    raw = open(path).read()
+    with open(path, "w") as fh:
+        fh.write(raw[: len(raw) // 2])
+    report = ag.aggregate_dir(str(tmp_path))
+    assert len(report["flight"]["found"]) == 1
+    assert report["flight"]["found"][0]["used_fallback"] is True
+    assert report["flight"]["rejected"] == [os.path.basename(path)]
+
+
+def test_flight_kill_switch(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYDRABADGER_FLIGHT", "0")
+    fr = _make_flight(tmp_path)
+    assert fr.dump("fault:test") is None
+    assert not os.listdir(tmp_path)
+
+
+# -- per-kind byte attribution ------------------------------------------------
+
+
+def test_bytes_rx_by_kind_ledger():
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    def leg(variant):
+        net = SimNetwork(
+            SimConfig(n_nodes=4, protocol="qhb", epochs=2, seed=29,
+                      rbc_variant=variant, meter_bytes=True,
+                      native_acs=False)
+        )
+        m = net.run()
+        net.shutdown()
+        assert m.agreement_ok
+        return m
+
+    bracha = leg("bracha")
+    lc = leg("lowcomm")
+    # the ledger partitions the rx total exactly
+    assert sum(bracha.bytes_rx_by_kind.values()) == bracha.bytes_rx_total
+    assert sum(lc.bytes_rx_by_kind.values()) == lc.bytes_rx_total
+    # and names the tier the variant changed: Merkle echoes vs bare-shard
+    assert "bc_echo" in bracha.bytes_rx_by_kind
+    assert "bc_echo_lc" in lc.bytes_rx_by_kind
+    assert lc.bytes_rx_by_kind["bc_echo_lc"] < bracha.bytes_rx_by_kind["bc_echo"]
+
+
+def test_wire_stream_bytes_rx_by_kind_bounded_names():
+    """TCP tier: the counter names are drawn from wire.KINDS (decode
+    enforces membership), so the registry stays bounded."""
+    import asyncio
+    import random
+
+    from hydrabadger_tpu.net import wire
+    from hydrabadger_tpu.obs.metrics import (
+        BYTES_RX_BY_KIND_PREFIX, MetricsRegistry,
+    )
+
+    async def run():
+        reg = MetricsRegistry()
+        done = asyncio.Event()
+
+        async def on_conn(reader, writer):
+            from hydrabadger_tpu.crypto.threshold import SecretKey
+
+            s = wire.WireStream(
+                reader, writer, SecretKey.random(random.Random(2)),
+                sign_frames=False,
+            )
+            s.metrics = reg
+            await s.recv()
+            await s.recv()
+            done.set()
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        from hydrabadger_tpu.crypto.threshold import SecretKey
+
+        tx = wire.WireStream(
+            reader, writer, SecretKey.random(random.Random(1)),
+            sign_frames=False,
+        )
+        await tx.send(wire.ping())
+        await tx.send(wire.transaction(b"abc"))
+        await asyncio.wait_for(done.wait(), 5)
+        tx.close()
+        server.close()
+        await server.wait_closed()
+        return reg.snapshot()["counters"]
+
+    counters = asyncio.run(run())
+    kinds = {
+        k[len(BYTES_RX_BY_KIND_PREFIX):]
+        for k in counters
+        if k.startswith(BYTES_RX_BY_KIND_PREFIX)
+    }
+    assert kinds == {"ping", "transaction"}
+    from hydrabadger_tpu.net.wire import KINDS
+
+    assert kinds <= KINDS
+
+
+# -- dkg_settle stage span ----------------------------------------------------
+
+
+def test_dkg_settle_span_rides_era_switch():
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    net = SimNetwork(
+        SimConfig(n_nodes=4, protocol="dhb", seed=5, native_acs=False,
+                  trace=True, txns_per_node_per_epoch=1)
+    )
+    net.run(1)
+    victim = net.ids[-1]
+    for nid in net.ids:
+        if nid != victim:
+            net.router.dispatch_step(
+                nid, net.nodes[nid].vote_to_remove(victim)
+            )
+    switched = False
+    for _ in range(8):
+        m = net.run(1)
+        assert m.agreement_ok
+        if all(net.nodes[n].era > 0 for n in net.ids if n != victim):
+            switched = True
+            break
+    net.shutdown()
+    assert switched, "era never switched"
+    settles = [e for e in net.recorder.events if e.name == "dkg_settle"]
+    assert settles, "no dkg_settle spans recorded across an era switch"
+    phases = {e.phase for e in settles}
+    assert phases == {"B", "E"}
+    b = next(e for e in settles if e.phase == "B")
+    assert {"era", "epoch", "node"} <= set(b.attrs)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_aggregate_cli_gate(tmp_path, capsys):
+    _synthetic_cluster(tmp_path)
+    fr = _make_flight(tmp_path, node="a")
+    fr.dump("fault:test")
+    rc = ag.main([
+        str(tmp_path),
+        "--report-out", str(tmp_path / "report.json"),
+        "--require-flight", "--require-critical-path",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "straggler c" in out
+    assert "gated by tdec" in out
+    report = json.load(open(tmp_path / "report.json"))
+    assert report["epoch_critical_stage"] == "tdec"
+    # the merged perfetto trace landed next to the feeds
+    merged = json.load(open(tmp_path / "cluster_timeline.json"))
+    assert merged["traceEvents"]
+    # and the gate FAILS loudly when the black box is missing
+    for f in os.listdir(tmp_path):
+        if ".flight." in f:
+            os.unlink(tmp_path / f)
+    rc = ag.main([str(tmp_path), "--require-flight"])
+    assert rc == 1

@@ -39,6 +39,27 @@ policy-driven abstract interpreter (``lint/dataflow.py``):
     ``RETRACE_BUDGETS`` table or config-bounded via
     ``lint/registry.py:CONFIG_BOUNDED_JIT`` (``lint/retrace_budget.py``).
 
+The hbrace passes (round 15) grow the same machinery into an
+async-aware concurrency and clock-domain analyzer:
+
+  * **await-interference** — a read-modify-write of shared node state
+    (``self.*`` reachable from >= 2 coroutines over the callgraph)
+    must not straddle a suspension point without re-validation or a
+    registered guard (``lint/await_interference.py``);
+  * **blocking-in-async** — declared blocking sinks (``time.sleep``,
+    fsync, subprocess waits, eager ``CryptoFuture`` materialization)
+    must not be reachable from an ``async def`` except through a
+    declared executor-offload boundary (``lint/blocking_async.py``);
+  * **clock-domain** — every timestamp source carries a declared
+    domain (wall / mono / skewed-mono / skewed-wall); cross-domain
+    arithmetic, skewed time in freshness checks, monotonic stamps in
+    persisted payloads and raw OS-clock reads bypassing the
+    ``_now()``/``wall_now()`` seams in ``net/``+``obs/`` are findings
+    (``lint/clock_domain.py``);
+  * **task-retention** — no fire-and-forget ``asyncio.create_task``:
+    a dropped handle is a GC-cancellation hazard
+    (``lint/task_retention.py``).
+
 Everything the passes treat as special is declared in
 ``lint/registry.py`` — the auditable contract surface.
 
@@ -142,9 +163,10 @@ def _suppressions(sf: SourceFile) -> Tuple[Dict[int, Dict[str, str]], List[Findi
 
 def all_rules():
     """The rule registry, in report order."""
-    from . import async_fetch, deadcode, env_flags, jit_hygiene
+    from . import async_fetch, await_interference, blocking_async
+    from . import clock_domain, deadcode, env_flags, jit_hygiene
     from . import limb_layout, mosaic, retrace_budget, sansio, secrets
-    from . import taint, wire_contract
+    from . import taint, task_retention, wire_contract
 
     return [
         sansio,
@@ -157,6 +179,10 @@ def all_rules():
         taint,
         secrets,
         retrace_budget,
+        await_interference,
+        blocking_async,
+        clock_domain,
+        task_retention,
         deadcode,
     ]
 

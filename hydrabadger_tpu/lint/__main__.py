@@ -59,9 +59,12 @@ def _snapshot(findings, suppressed) -> dict:
 def _changed_files() -> list:
     """Package .py files the git diff (incl. untracked) touches.
 
-    When the diff touches ``lint/`` or the package ``__init__.py``, the
-    anchor file is added so the whole-package dataflow passes run too —
-    an edit to the analyzer must re-run the analyzer."""
+    When ANY package file changed, the ``__init__.py`` anchor is added
+    so the whole-package dataflow passes (attacker/secret taint,
+    retrace-budget, await-interference, blocking-in-async,
+    clock-domain) run too: they are interprocedural, so an edit
+    anywhere can change their verdicts, and they cost seconds.  The
+    fast path saved is the per-file rules over the unchanged files."""
     root = PACKAGE_ROOT.parent
     out = set()
     for cmd in (
@@ -82,11 +85,8 @@ def _changed_files() -> list:
                 and p.exists()
             ):
                 out.add(p)
-    anchor = PACKAGE_ROOT / "__init__.py"
-    if any(
-        p == anchor or p.is_relative_to(PACKAGE_ROOT / "lint") for p in out
-    ):
-        out.add(anchor)
+    if out:
+        out.add(PACKAGE_ROOT / "__init__.py")
     return sorted(out)
 
 
@@ -139,6 +139,13 @@ def main(argv=None) -> int:
         "--changed",
         action="store_true",
         help="fast path: lint only git-changed package files",
+    )
+    parser.add_argument(
+        "--github",
+        action="store_true",
+        help="emit failing findings as GitHub workflow annotations "
+        "(::error file=...,line=...::message) alongside the plain "
+        "diagnostics",
     )
     parser.add_argument(
         "-q", "--quiet", action="store_true", help="suppress the summary line"
@@ -240,6 +247,14 @@ def main(argv=None) -> int:
     else:
         for f in fail_findings:
             print(f.render())
+            if args.github:
+                # workflow-annotation format: one ::error per failing
+                # finding; GitHub renders it inline on the PR diff
+                msg = f"{f.rule}: {f.message}".replace("\n", " ")
+                print(
+                    f"::error file={f.path},line={f.line},"
+                    f"title=hblint {f.rule}::{msg}"
+                )
         for f in grandfathered:
             print(f"{f.render()}  [grandfathered]")
         for f, j in new_suppressions:
@@ -247,6 +262,12 @@ def main(argv=None) -> int:
                 f"{f.path}:{f.line}: {f.rule}: NEW suppression "
                 f"({j!r}) — audit it, then `--write-baseline`"
             )
+            if args.github:
+                print(
+                    f"::error file={f.path},line={f.line},"
+                    f"title=hblint new suppression::{f.rule}: "
+                    f"unaudited suppression ({j})"
+                )
     if not args.quiet and not args.json:
         noun = "finding" if len(fail_findings) == 1 else "findings"
         extra = (

@@ -157,6 +157,11 @@ class CallSite:
     dotted: Optional[str]
     targets: List[str] = field(default_factory=list)  # resolved qualnames
     kind: str = "call"  # "call" | "ctor"
+    # resolution confidence: "typed" for rules 1-4, "fallback" for the
+    # rule-5 unique-method guess.  Reachability-style consumers (the
+    # blocking-in-async pass) skip fallback edges — a guessed edge into
+    # a blocking helper would smear findings across unrelated planes.
+    via: str = "typed"
 
 
 def _is_jit_decorator(dec: ast.AST) -> bool:
@@ -633,6 +638,7 @@ class CallGraph:
                 cands = self.methods_by_name.get(meth, [])
                 if 0 < len(cands) <= 2:
                     site.targets.extend(mi.qualname for mi in cands)
+                    site.via = "fallback"
             return site
 
         # attribute calls + plain calls, attributed to their enclosing fn

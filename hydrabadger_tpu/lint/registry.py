@@ -317,6 +317,145 @@ CONFIG_BOUNDED_JIT = {
 }
 
 # --------------------------------------------------------------------------
+# hbrace — async-interference & clock-domain passes
+# --------------------------------------------------------------------------
+
+# await-interference (lint/await_interference.py): read-modify-write of
+# shared node state spanning an await point, declared safe.  Key is
+# "relpath::Class.method::attr" — the coroutine that performs the RMW
+# and the attribute it straddles; the value is the justification a
+# reviewer audits (why the write cannot be stale: a single-writer
+# discipline, a CAS-style re-check the analysis cannot see, ...).  An
+# entry naming a function that no longer exists is itself a finding.
+AWAIT_RMW_GUARDS: dict = {}
+
+# blocking-in-async (lint/blocking_async.py): calls that block the OS
+# thread — on the asyncio event loop they stall EVERY node pump sharing
+# it.  Matched on the dotted call name's suffix (alias-tolerant for the
+# stdlib time/os/subprocess modules).
+BLOCKING_CALLS = {
+    "time.sleep": "thread sleep",
+    "os.fsync": "disk flush",
+    "os.fdatasync": "disk flush",
+    "subprocess.run": "child-process wait",
+    "subprocess.call": "child-process wait",
+    "subprocess.check_call": "child-process wait",
+    "subprocess.check_output": "child-process wait",
+    "open": "synchronous file open",
+}
+
+# Declared executor-offload boundaries: functions that DO name a
+# blocking call in their body but ship the work off the event loop (or
+# run it only on a path that is not on the loop).  Traversal of the
+# async-reachability BFS stops here; each entry carries the
+# justification.  A stale entry (function gone) is a finding.
+EXECUTOR_OFFLOAD_BOUNDARIES = {
+    "net/node.py::Hydrabadger._persist_checkpoint": (
+        "disk work (two fsyncs + rotation) runs on the default executor "
+        "on the hot path; the inline sync=True branch runs only at "
+        "graceful stop, after the wire pumps are being torn down"
+    ),
+    "obs/flight.py::FlightRecorder.dump": (
+        "the payload is captured synchronously from live rings, then "
+        "the fsync+rotate write is offloaded to the default executor "
+        "when a loop is running; inline only at stop/SIGTERM and in "
+        "loop-less harnesses"
+    ),
+}
+
+# clock-domain (lint/clock_domain.py).  Every timestamp source is
+# declared with its domain; arithmetic mixing two domains, skewed time
+# feeding supervisor freshness checks, monotonic stamps persisted into
+# checkpoints/flight dumps, and raw OS-clock reads inside net/+obs/
+# that bypass the node seams are findings.
+#
+#   source                      domain        axis
+#   time.time()                 wall          host epoch seconds
+#   time.monotonic()            mono          host monotonic
+#   time.perf_counter()         mono          host monotonic
+#   loop.time()                 mono          host monotonic (asyncio)
+#   Hydrabadger._now()          skewed-mono   node monotonic + injected
+#                                             offset/drift
+#   Hydrabadger.wall_now()      skewed-wall   node wall + injected skew
+#   feed field "t"              skewed-wall   node-stamped feed rows
+#   feed field "t_host"         wall          honest host stamp (r14)
+CLOCK_SOURCE_DOMAINS = {
+    "time.time": "wall",
+    "time.monotonic": "mono",
+    "time.perf_counter": "mono",
+}
+
+# Bare method names whose RETURN VALUE carries a declared domain
+# wherever the receiver came from (the node clock seams).
+CLOCK_METHOD_DOMAINS = {
+    "_now": "skewed-mono",
+    "wall_now": "skewed-wall",
+}
+
+# Summary/batch feed fields with a declared domain, tracked in the
+# declared consumer modules (string-keyed subscripts and .get() reads).
+CLOCK_FEED_FIELD_DOMAINS = {
+    "t": "skewed-wall",
+    "t_host": "wall",
+}
+CLOCK_FEED_CONSUMERS = ("net/cluster.py",)
+
+# Cross-object attributes with a declared domain (set in one class,
+# read in another — the per-function inference cannot see across).
+CLOCK_ATTR_DOMAINS = {
+    # stamped by the owning node's _now() at construction (net/node.py)
+    # so the handshake-stall timer and the stamp share one domain
+    "born": "skewed-mono",
+    "_last_progress_t": "skewed-mono",
+}
+
+# Functions allowed to read raw OS clocks inside net/ + obs/: THE
+# injection seams everything else must route through.
+CLOCK_INJECTION_POINTS = {
+    "net/node.py::Hydrabadger._now": (
+        "the skewed monotonic seam: every node timer reads this"
+    ),
+    "net/node.py::Hydrabadger.wall_now": (
+        "the skewed wall seam: every observability stamp reads this"
+    ),
+    "obs/recorder.py::domain_clock": (
+        "the declared domain-reader factory (obs/recorder.py DOMAIN_*)"
+    ),
+}
+
+# Whole modules that legitimately read HOST clocks in net/+obs/: the
+# supervisor/harness tier observes child incarnations from outside and
+# has no node seam to route through — its clocks are the honest truth
+# the skewed feeds are corrected against.
+HOST_CLOCK_MODULES = {
+    "net/cluster.py": (
+        "process supervisor: measures honest host time across child "
+        "incarnations (restart/watchdog/health timers); the skew it "
+        "injects into children must never reach its own rulers"
+    ),
+    "net/chaos.py": (
+        "chaos harness: wall budgets, partition heal deadlines and "
+        "recovery catch-up are measured on the honest host clock"
+    ),
+}
+
+# Persistence payload builders: a mono/skewed-mono value in the payload
+# is meaningless after a restart (monotonic clocks reset at boot).
+CLOCK_PERSIST_FUNCS = {
+    "obs/flight.py::FlightRecorder.black_box": (
+        "the flight-dump payload read by the aggregator"
+    ),
+}
+
+# Freshness/health deciders: skewed node time in a staleness decision
+# makes a skewed-fast node's feed look eternally fresh (round 14).
+CLOCK_FRESHNESS_FUNCS = {
+    "net/cluster.py::ClusterSupervisor.health": (
+        "supervisor feed-staleness report: compares against t_host"
+    ),
+}
+
+# --------------------------------------------------------------------------
 # environment flags (lint/env_flags.py)
 # --------------------------------------------------------------------------
 

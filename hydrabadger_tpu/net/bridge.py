@@ -70,13 +70,16 @@ class CryptoBridge:
         self._closed = True
         if self._wake is not None:
             self._wake.set()
-        if self._task is not None:
-            self._task.cancel()  # don't wait out a straggler window
+        # swap-then-await (the double-buffer discipline): writing
+        # self._task = None AFTER the await would clobber a task a
+        # concurrent start() installed during the cancellation await
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()  # don't wait out a straggler window
             try:
-                await self._task
+                await task
             except asyncio.CancelledError:
                 pass
-            self._task = None
         for _kind, _args, fut in self._pending:
             if not fut.done():
                 fut.cancel()

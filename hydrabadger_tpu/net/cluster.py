@@ -474,7 +474,11 @@ class ClusterSupervisor:
             # feed freshness compares against the HONEST host clock
             # (t_host, round 14) — the skewed node clock in `t` is the
             # aggregator's anchor, and measuring staleness with it
-            # would make a skewed-fast node's feed look eternally fresh
+            # would make a skewed-fast node's feed look eternally
+            # fresh.  A pre-r14 feed without t_host reports None
+            # (honestly unknown) rather than falling back to the
+            # skewed `t`: wall minus skewed-wall measures the injected
+            # skew, not the age (lint clock-domain).
             report.append(
                 {
                     "node": child.index,
@@ -483,8 +487,8 @@ class ClusterSupervisor:
                     "last_exit": child.last_exit,
                     "state": s.get("state") if s else None,
                     "summary_age_s": (
-                        round(now - s.get("t_host", s["t"]), 2)
-                        if s else None
+                        round(now - s["t_host"], 2)
+                        if s and "t_host" in s else None
                     ),
                     "frontier": self.frontier(child.index),
                 }

@@ -394,48 +394,52 @@ class Hydrabadger:
         # re-verify hundreds of stale frames at every receiver — a
         # quadratic death spiral.  Track epoch-duration EMA + back off.
         self._epoch_ema_s: Optional[float] = None
-        self._last_progress_t = _time.monotonic()
-        self._replay_backoff = 1.0
-        self._last_replay_t = 0.0  # monotonic time of the last replay
-        self._replayed_since_progress = False
-        # user/generator contributions awaiting an epoch whose proposal
-        # slot is still free (merged, in order, at the next opportunity)
-        self._pending_user: deque = deque(maxlen=4096)
-        self._transcript_served: Dict[OutAddr, float] = {}  # rate limiting
-        self._server: Optional[asyncio.base_events.Server] = None
-        self._stopped = asyncio.Event()
-        self._gen_txns: Optional[Callable[[int, int], List[bytes]]] = None
-        self.engine = get_engine(self.cfg.engine)
-        # per-node clock skew (process-tier chaos): the supervisor
-        # injects an offset and/or drift RATE via environment, and this
-        # node's replay/backoff/gap timers read the skewed clock — a
-        # node whose timers run 1.5x fast genuinely replays early and
-        # declares stalls sooner, the OS-level timing tail the
-        # in-process planes cannot model.  Two consumers: the monotonic
-        # timer clock (_now: progress/replay/gap bookkeeping this node
-        # both writes and reads), and the WALL clock this node stamps
-        # its observability feeds with (wall_now: trace events, batch
-        # log, summary lines) — the skewed feeds are exactly what the
-        # cluster aggregator (obs/aggregate.py) must CORRECT from
-        # committed-batch anchors rather than trust.  Cross-object
-        # timestamps (peer.born) stay on the host clock.
+        # per-node clock seams (process-tier chaos + test injection):
+        # the supervisor injects an offset and/or drift RATE via
+        # environment, and every node timer reads the skewed clock
+        # (_now) while every observability stamp reads the skewed wall
+        # clock (wall_now) — the feeds the cluster aggregator
+        # (obs/aggregate.py) must CORRECT from committed-batch anchors
+        # rather than trust.  _mono_base is the injectable monotonic
+        # ruler underneath both: tests swap it for a fake clock so
+        # timing pins stop racing the wall clock under host load.
+        self._mono_base: Callable[[], float] = _time.monotonic
         self._clock_offset_s = float(
             _os.environ.get("HYDRABADGER_CLOCK_SKEW_S") or 0.0
         )
         self._clock_rate = float(
             _os.environ.get("HYDRABADGER_CLOCK_RATE") or 1.0
         )
-        # the construction-time stamp above predates the skew fields:
-        # re-stamp on the node clock so every later read is coherent
         self._last_progress_t = self._now()
+        self._replay_backoff = 1.0
+        # node-clock time of the last replay.  -inf = never: the node
+        # clock is SKEWED (a negative HYDRABADGER_CLOCK_SKEW_S can make
+        # _now() negative for the whole run), so 0.0 is not "long ago"
+        # — it would permanently suppress replays on a clock-behind
+        # node.  Same discipline for every "last fired" sentinel below.
+        self._last_replay_t = float("-inf")
+        self._replayed_since_progress = False
+        # user/generator contributions awaiting an epoch whose proposal
+        # slot is still free (merged, in order, at the next opportunity)
+        self._pending_user: deque = deque(maxlen=4096)
+        self._transcript_served: Dict[OutAddr, float] = {}  # rate limiting
+        # node-clock time of the last transcript REPLAY attempt (the
+        # O(n^2) processing side); None = never (see _last_replay_t)
+        self._last_transcript_attempt: Optional[float] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._stopped = asyncio.Event()
+        self._gen_txns: Optional[Callable[[int, int], List[bytes]]] = None
+        self.engine = get_engine(self.cfg.engine)
         # durable checkpoint store (Config.checkpoint_path): every
         # rejection/fallback inside the store lands in this node's
         # fault ring + metrics, so the supervisor-tier observability
         # contract sees disk corruption exactly like a wire fault
         # flight recorder (obs/flight.py): mounted by the harness
         # (__main__ --flight / the cluster supervisor); every fault-ring
-        # entry and the graceful stop dump the black box
-        self.flight = None
+        # entry and the graceful stop dump the black box.  Typed slot:
+        # the lint callgraph resolves flight.* calls through it, so the
+        # blocking-in-async pass sees the dump boundary for real.
+        self.flight: Optional["FlightRecorder"] = None
         self._ckpt_store = None
         self._ckpt_inflight = None  # at most one executor write in flight
         if self.cfg.checkpoint_path:
@@ -448,8 +452,13 @@ class Hydrabadger:
             )
 
     def _now(self) -> float:
-        """This node's monotonic clock, with injected skew applied."""
-        return self._clock_offset_s + self._clock_rate * _time.monotonic()
+        """This node's monotonic clock, with injected skew applied.
+
+        THE timer seam (lint clock-domain: every raw clock read in the
+        node routes through here, so injected skew — and a test's fake
+        ``_mono_base`` — reaches every timer: replay backoff, stall
+        declarations, handshake culls, transcript cooldowns)."""
+        return self._clock_offset_s + self._clock_rate * self._mono_base()
 
     def wall_now(self) -> float:
         """This node's WALL clock — host wall time plus the injected
@@ -462,7 +471,7 @@ class Hydrabadger:
         return (
             _time.time()
             + self._clock_offset_s
-            + (self._clock_rate - 1.0) * _time.monotonic()
+            + (self._clock_rate - 1.0) * self._mono_base()
         )
 
     # -- public API (hydrabadger.rs:127-603) --------------------------------
@@ -723,8 +732,10 @@ class Hydrabadger:
         self._persist_checkpoint(sync=True)
         if self.flight is not None:
             # black-box contract: a graceful stop (SIGTERM tier) leaves
-            # a final flight dump next to the final checkpoint
-            self.flight.dump("stop")
+            # a final flight dump next to the final checkpoint — inline
+            # (sync=True): the process exits right after, an offloaded
+            # write could die with it
+            self.flight.dump("stop", sync=True)
         if self._server is not None:
             self._server.close()
         self.peers.close_all()
@@ -784,7 +795,9 @@ class Hydrabadger:
         addr = writer.get_extra_info("peername") or ("?", 0)
         out_addr = OutAddr(addr[0], addr[1])
         stream = self._new_stream(reader, writer)
-        peer = Peer(out_addr, stream, metrics=self.metrics)
+        # born on the NODE clock: the handshake-cull subtraction must
+        # not mix the skewed node domain with the host's (clock-domain)
+        peer = Peer(out_addr, stream, metrics=self.metrics, born=self._now())
         peer.start_pump()
         self.peers.add(peer)
         try:
@@ -833,7 +846,10 @@ class Hydrabadger:
             log.error("giving up dialling %s", remote)
             return
         stream = self._new_stream(reader, writer)
-        peer = Peer(remote, stream, outgoing=True, metrics=self.metrics)
+        peer = Peer(
+            remote, stream, outgoing=True, metrics=self.metrics,
+            born=self._now(),  # node clock: see _cull_stalled_handshakes
+        )
         peer.start_pump()
         self.peers.add(peer)
         peer.send(
@@ -1184,9 +1200,14 @@ class Hydrabadger:
                 and self.dhb.last_transcript is not None
                 and self.dhb.last_transcript[0] == want_era
             ):
-                now = asyncio.get_event_loop().time()
-                last = self._transcript_served.get(peer.out_addr, 0.0)
-                if now - last < 3.0:
+                # node clock (_now), not loop.time(): injected skew
+                # must reach the serve cooldown like every other timer.
+                # None = never served (a 0.0 sentinel would close the
+                # gate forever on a clock-behind node whose _now() is
+                # negative)
+                now = self._now()
+                last = self._transcript_served.get(peer.out_addr)
+                if last is not None and now - last < 3.0:
                     return
                 self._transcript_served[peer.out_addr] = now
                 era, kg_era, entries = self.dhb.last_transcript
@@ -2101,8 +2122,6 @@ class Hydrabadger:
         # count by what this era's DKG could legitimately produce —
         # without this, any established peer could burn our CPU with
         # repeated forged transcripts while we are stranded (ADVICE r2).
-        import time as _time
-
         try:
             era, kg_era, entries = payload
             era, kg_era = int(era), int(kg_era)
@@ -2121,9 +2140,12 @@ class Hydrabadger:
         # rate-limit only the EXPENSIVE replay, and only after the cheap
         # structural checks — a peer spamming trivially-invalid frames
         # must not be able to renew the window and starve the genuine
-        # transcript forever
-        now = _time.monotonic()
-        if now - getattr(self, "_last_transcript_attempt", 0.0) < 3.0:
+        # transcript forever.  Node clock (_now): injected skew must
+        # reach the processing cooldown like the serve cooldown; None
+        # sentinel for the same negative-skew reason as the serve side.
+        now = self._now()
+        last = self._last_transcript_attempt
+        if last is not None and now - last < 3.0:
             return
         self._last_transcript_attempt = now
         if d.install_share_from_transcript(entries, kg_era):
@@ -2296,8 +2318,13 @@ class Hydrabadger:
         verified frames forever while both ends believe it is merely
         slow.  Aborting errors both pumps; outgoing links re-dial
         (their out_addr IS the remote's listener), incoming ones are
-        re-dialled by the remote's own cull."""
-        now = _time.monotonic()
+        re-dialled by the remote's own cull.
+
+        Node clock on BOTH sides of the age subtraction: ``peer.born``
+        is stamped from this node's ``_now()`` at construction, so the
+        handshake-stall timer lives in one clock domain and injected
+        skew/drift genuinely reaches it (lint clock-domain)."""
+        now = self._now()
         for peer in list(self.peers.by_addr.values()):
             if (
                 peer.state != "handshaking"

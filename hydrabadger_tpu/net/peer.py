@@ -51,7 +51,10 @@ class Peer:
     # past the node's handshake timeout (a hello/welcome lost in
     # flight — chaos plane, lossy link) is culled and re-dialled,
     # because handshake frames are sent exactly once and nothing else
-    # retries them
+    # retries them.  The owning node stamps this from its OWN clock
+    # (Hydrabadger._now) so the cull subtraction stays in one domain
+    # and injected skew reaches the handshake timer; the host default
+    # covers peers built outside a node (tests, tools).
     born: float = field(default_factory=_time.monotonic)
     # obs/metrics registry of the owning node (set when the node adopts
     # the connection); per-frame tx counters + overflow events land here

@@ -34,9 +34,11 @@ registered in lint/registry.py ENV_FLAGS.
 """
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import os
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -79,6 +81,7 @@ class FlightRecorder:
         capacity: int = 4096,
         min_interval_s: float = 1.0,
         clock=None,
+        mono=None,
     ):
         self.prefix = prefix
         self.node = node
@@ -88,9 +91,23 @@ class FlightRecorder:
         self.capacity = capacity
         self.min_interval_s = min_interval_s
         self.clock = clock or time.time
+        # the debounce ruler: injectable (harness passes node._now) so
+        # injected skew — and a test's fake clock — reaches the dump
+        # cadence like every other node timer (lint clock-domain)
+        self._mono = mono or time.monotonic
         self.path = f"{prefix}.{os.getpid()}{FLIGHT_SUFFIX}"
         self.dumps = 0
-        self._last_dump_t = 0.0  # monotonic
+        # self._mono domain; -inf = never dumped.  The injected seam is
+        # the node's SKEWED clock, which a negative offset can hold
+        # below zero for the whole run — a 0.0 sentinel would debounce
+        # every dump away and the node would leave no black box at all.
+        self._last_dump_t = float("-inf")
+        self._write_inflight = None  # at most one executor write
+        # serializes the executor-offloaded write against an inline
+        # (sync=True) stop dump: both share one tmp path and one
+        # rotation sequence, and interleaving them would tear the very
+        # black box the stop path exists to leave behind
+        self._write_lock = threading.Lock()
         self._dirty = False
         # tail fingerprint of the recorder ring at the last dump: the
         # heartbeat must keep dumping while a FAULT-FREE node makes
@@ -122,7 +139,7 @@ class FlightRecorder:
         literally nothing new was recorded since the last dump — a
         fault-free node that keeps committing keeps dumping, so the
         black box stays at most one interval stale."""
-        now = time.monotonic()
+        now = self._mono()
         if now - self._last_dump_t < self.min_interval_s:
             return False
         if (
@@ -168,14 +185,78 @@ class FlightRecorder:
             "counters": counters,
         }
 
-    def dump(self, reason: str) -> Optional[str]:
+    def dump(self, reason: str, sync: bool = False) -> Optional[str]:
         """Atomic generational dump; returns the path (None when the
-        plane is disabled or the write failed — a full disk must never
-        take the node down with it)."""
+        plane is disabled, the write failed — a full disk must never
+        take the node down with it — or an offloaded write is still in
+        flight).
+
+        The payload is captured synchronously from the live rings (they
+        mutate under the event loop), then the disk half — two fsyncs +
+        rotation — is offloaded to the default executor when a loop is
+        running: a fault storm inside the handler loop must debounce
+        into background writes, not stall the wire plane for the fsync
+        latency (lint blocking-in-async; the checkpoint store made the
+        same move in PR 10).  ``sync=True`` (graceful stop / SIGTERM,
+        loop-less harnesses) writes inline: the process is about to
+        exit and the black box must hit disk first."""
         if not flight_enabled():
             return None
         payload = self.black_box(reason)
         doc = {"flight": payload, "sha256": _payload_digest(payload)}
+        loop = None
+        if not sync:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None
+        if loop is not None:
+            if (
+                self._write_inflight is not None
+                and not self._write_inflight.done()
+            ):
+                return None  # one write in flight; the debounce owns cadence
+            fut = loop.run_in_executor(None, self._write, doc)
+            self._write_inflight = fut
+            # bookkeeping at submit time: the debounce window starts
+            # when the dump was TAKEN (the payload is already frozen)...
+            self.dumps += 1
+            self._last_dump_t = self._mono()
+            self._dirty = False
+            self._last_tail = self._ring_tail()
+
+            def _settled(f):
+                # ...but a FAILED write (disk full) must not stand as a
+                # dump: restore the dirty/tail state so the next
+                # heartbeat retries instead of skipping a quiescent node
+                failed = f.cancelled() or f.exception() is not None
+                if not failed and f.result() is not None:
+                    return
+                self.dumps -= 1
+                self._dirty = True
+                self._last_tail = None
+
+            fut.add_done_callback(_settled)
+            return self.path
+        if self._write(doc) is None:
+            return None
+        self.dumps += 1
+        self._last_dump_t = self._mono()
+        self._dirty = False
+        self._last_tail = self._ring_tail()
+        return self.path
+
+    def _write(self, doc: dict) -> Optional[str]:
+        """The blocking half: tmp-write + fsync + rotate + dir fsync.
+        Runs inline (stop path) or on the default executor; the lock
+        serializes the two, so a terminal stop dump and an in-flight
+        heartbeat write can never interleave on the shared tmp path or
+        rotation — whichever lands second rotates the other to ``.1``,
+        and the loader reads both generations."""
+        with self._write_lock:
+            return self._write_locked(doc)
+
+    def _write_locked(self, doc: dict) -> Optional[str]:
         tmp = self.path + ".tmp"
         try:
             with open(tmp, "w") as fh:
@@ -196,10 +277,6 @@ class FlightRecorder:
             except OSError:
                 pass
             return None
-        self.dumps += 1
-        self._last_dump_t = time.monotonic()
-        self._dirty = False
-        self._last_tail = self._ring_tail()
         return self.path
 
 

@@ -362,7 +362,11 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
             await node.start(remotes, gen)
         while not all(m.is_validator() for m in nodes):
             await asyncio.sleep(0.2)
-        rss0 = rss_mb()
+        # procfs sampling off the loop: the cluster under test runs on
+        # THIS loop, so even a small synchronous read steals time from
+        # the epochs it is measuring (lint blocking-in-async)
+        loop = asyncio.get_running_loop()
+        rss0 = await loop.run_in_executor(None, rss_mb)
         t0 = time.perf_counter()
         peaks = {"deferred": 0, "future": 0, "retry": 0, "outbox": 0}
         committed = [0] * n
@@ -375,9 +379,10 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
                 # per-node committed counts expose a stalled node, the
                 # rate exposes throughput decay
                 done = min(committed)
+                rss_now = await loop.run_in_executor(None, rss_mb)
                 print(
                     f"soak progress: {committed} epochs, "
-                    f"{done / (now - t0):.3f} eps, rss {rss_mb():.0f} MB",
+                    f"{done / (now - t0):.3f} eps, rss {rss_now:.0f} MB",
                     flush=True,
                 )
                 last_report = now
@@ -402,7 +407,7 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
                 peaks["retry"] = max(peaks["retry"], len(m._wire_retry))
                 peaks["outbox"] = max(peaks["outbox"], len(m._epoch_outbox))
         dt = time.perf_counter() - t0
-        rss1 = rss_mb()
+        rss1 = await loop.run_in_executor(None, rss_mb)
         # fold every node's registry into one snapshot row: counters
         # sum, gauges take the worst node (high-water semantics)
         merged = _merge_metrics([m.metrics.snapshot() for m in nodes])

@@ -92,3 +92,20 @@ def _retrace_budget_guard():
         "retrace budget drift detected at session teardown:\n  "
         + "\n  ".join(violations)
     )
+
+
+class FakeMono:
+    """A hand-advanced monotonic clock for the Hydrabadger._mono_base /
+    FlightRecorder ``mono`` seams: timing pins advance time themselves
+    instead of sleeping wall-clock, so they stop racing host load (the
+    known tier-1 sensitivity)."""
+
+    def __init__(self, t0: float = 1000.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t

@@ -308,6 +308,64 @@ def test_flight_dump_roundtrip_and_rotation(tmp_path):
     assert obs_flight.load_flight(path + ".1")["reason"] == "fault:test"
 
 
+def test_flight_debounce_rides_injected_mono_seam(tmp_path):
+    """Round 15: the dump debounce reads the injectable ``mono`` seam
+    (the node passes ``_now``), so injected skew — and this fake
+    clock — reaches the dump cadence; no wall sleeps needed."""
+    from collections import deque
+
+    from conftest import FakeMono
+    from hydrabadger_tpu.net.node import WireFault
+
+    fake = FakeMono(t0=100.0)
+    ring = deque([("n0", WireFault("wire: x"))])
+    fr = obs_flight.FlightRecorder(
+        str(tmp_path / "n0.flight"), node="n0", fault_ring=ring,
+        min_interval_s=1.0, mono=fake,
+    )
+    assert fr.maybe_dump("fault:x") is True
+    assert fr.maybe_dump("fault:x") is False  # debounced on the seam
+    fake.advance(0.5)
+    assert fr.maybe_dump("fault:x") is False  # still inside the window
+    fake.advance(0.6)
+    assert fr.maybe_dump("fault:x") is True  # window elapsed (fake time)
+    assert fr.dumps == 2
+    # negative-clock regression: the seam is the node's SKEWED clock,
+    # which a clock-behind node holds below zero — the FIRST dump must
+    # still fire (a 0.0 "never" sentinel would debounce it away)
+    fr2 = obs_flight.FlightRecorder(
+        str(tmp_path / "neg.flight"), node="n1", fault_ring=ring,
+        min_interval_s=1.0, mono=FakeMono(t0=-400000.0),
+    )
+    assert fr2.maybe_dump("fault:x") is True
+    assert fr2.dumps == 1
+
+
+def test_flight_dump_offloads_write_under_a_running_loop(tmp_path):
+    """Round 15 (blocking-in-async): under a running loop the fsync
+    half runs on the default executor — the payload is still captured
+    synchronously, the dump loads identically, and the terminal
+    ``sync=True`` path writes inline."""
+    import asyncio
+
+    fr = _make_flight(tmp_path)
+
+    async def drive():
+        p = fr.dump("fault:offloaded")
+        assert p == fr.path
+        assert fr._write_inflight is not None
+        # settle the executor write before asserting on-disk state
+        await fr._write_inflight
+        # a terminal dump writes inline even on the loop
+        p2 = fr.dump("stop", sync=True)
+        assert p2 == fr.path
+
+    asyncio.run(drive())
+    payload = obs_flight.load_flight(fr.path)
+    assert payload["reason"] == "stop"
+    assert obs_flight.load_flight(fr.path + ".1")["reason"] == "fault:offloaded"
+
+
 def test_torn_flight_dump_rejected_with_generation_fallback(tmp_path):
     """The satellite pin: a dump interrupted mid-write (SIGKILL
     emulation: truncated bytes) must be rejected LOUDLY and the

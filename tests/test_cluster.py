@@ -130,8 +130,12 @@ def test_summaries_merge_across_incarnations(tmp_path):
 
 @pytest.mark.asyncio
 async def test_node_clock_honors_injected_skew(monkeypatch):
+    """Deflaked (round 15): the drift assertion drives the injected
+    ``_mono_base`` seam instead of sleeping wall-clock, so the pin is
+    EXACT and cannot race concurrent host load."""
     import time as _time
 
+    from conftest import FakeMono
     from hydrabadger_tpu.net.node import Config, Hydrabadger
     from hydrabadger_tpu.utils.ids import InAddr
 
@@ -146,13 +150,66 @@ async def test_node_clock_honors_injected_skew(monkeypatch):
     # offset + 2x drift: the skewed node's timers genuinely run fast —
     # its replay/stall machinery sees double the elapsed wall time
     assert skewed._now() == pytest.approx(120.0 + 2.0 * now, rel=0.01)
-    a = skewed._now()
-    _time.sleep(0.05)
-    # 0.05 s of wall time reads as ~0.1 s on the 2x-drift clock
-    assert (skewed._now() - a) == pytest.approx(0.1, rel=0.5)
-    # progress stamps were re-taken on the node clock, so the replay
+    # progress stamps were taken on the node clock, so the replay
     # gate's arithmetic stays coherent under skew
     assert skewed._last_progress_t >= 120.0
+    # swap in the fake ruler: 0.05 s of "wall" reads as EXACTLY 0.1 s
+    # on the 2x-drift clock
+    fake = FakeMono(t0=50.0)
+    skewed._mono_base = fake
+    a = skewed._now()
+    fake.advance(0.05)
+    assert skewed._now() - a == pytest.approx(0.1)
+    # the wall seam drifts on the same ruler
+    w = skewed.wall_now()
+    fake.advance(1.0)
+    assert skewed.wall_now() - w == pytest.approx(1.0, abs=0.05)
+
+
+@pytest.mark.asyncio
+async def test_transcript_cooldowns_ride_the_node_clock(monkeypatch):
+    """Regression (round 15): the era-transcript PROCESSING cooldown
+    read the host clock directly, so injected skew (and fake clocks)
+    never reached it — the clock-domain pass flagged it; pin the fix
+    with a hand-advanced clock and zero wall sleeps."""
+    from types import SimpleNamespace
+
+    from conftest import FakeMono
+    from hydrabadger_tpu.net.node import Config, Hydrabadger
+    from hydrabadger_tpu.utils.ids import InAddr
+
+    node = Hydrabadger(InAddr("127.0.0.1", 4409), Config(), seed=3)
+    fake = FakeMono(t0=200.0)
+    node._mono_base = fake
+    calls = []
+    node.dhb = SimpleNamespace(
+        era=1,
+        netinfo=SimpleNamespace(
+            sk_share=None, node_ids=(node.uid.bytes, b"\x01" * 16)
+        ),
+        install_share_from_transcript=lambda entries, kg: (
+            calls.append(kg) or False
+        ),
+    )
+    payload = (1, 0, ())
+    node._on_era_transcript(payload)
+    assert len(calls) == 1  # first attempt processes
+    fake.advance(1.0)
+    node._on_era_transcript(payload)
+    assert len(calls) == 1  # inside the 3 s cooldown: rate-limited
+    fake.advance(2.5)
+    node._on_era_transcript(payload)
+    assert len(calls) == 2  # cooldown elapsed on the NODE clock
+    # negative-clock regression: a clock-BEHIND node (_now() < 0, e.g.
+    # a large negative HYDRABADGER_CLOCK_SKEW_S) must still process its
+    # FIRST attempt — a 0.0 "never" sentinel would close the gate
+    # forever because now - 0.0 is always < 3 when now is negative
+    node2 = Hydrabadger(InAddr("127.0.0.1", 4410), Config(), seed=4)
+    node2._mono_base = FakeMono(t0=-400000.0)
+    node2.dhb = node.dhb
+    calls.clear()
+    node2._on_era_transcript(payload)
+    assert len(calls) == 1, "negative node clock wedged the cooldown gate"
 
 
 # -- the SIGTERM graceful-shutdown contract (real subprocesses) ---------------

@@ -11,18 +11,24 @@ from hydrabadger_tpu.lint import (
     PACKAGE_ROOT,
     SourceFile,
     async_fetch,
+    await_interference,
+    blocking_async,
     callgraph,
+    clock_domain,
     deadcode,
     env_flags,
     jit_hygiene,
     limb_layout,
     mosaic,
+    registry,
     retrace_budget,
     sansio,
     secrets,
     taint,
+    task_retention,
     wire_contract,
 )
+from hydrabadger_tpu.lint.asyncflow import reachable_map
 
 
 def make_sf(tmp_path, relpath, code):
@@ -730,3 +736,449 @@ def test_scenario_plane_taint_sources_fire_on_known_bad(tmp_path):
     assert any("unbounded growth of self.seen" in m for m in messages)
     assert any("tainted loop bound" in m for m in messages)
     assert any("unbounded growth of self.history" in m for m in messages)
+
+
+# -- hbrace: the async-interference & clock-domain passes ---------------------
+
+
+@pytest.mark.hbrace
+def test_await_interference_fires_on_known_bad(tmp_path):
+    """The static twin of the hbasync double-buffer discipline: a
+    coroutine snapshots shared state, awaits a submit_* future, and
+    writes the snapshot-derived value back — flagged.  AugAssign,
+    RHS re-reads and post-await re-validation are all fresh."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/bad.py": """\
+                class Handler:
+                    def __init__(self):
+                        self.frontier = 0
+
+                    async def on_frame(self, engine, msg):
+                        snap = self.frontier
+                        fut = engine.submit_verify(msg)
+                        await fut
+                        self.frontier = snap + 1
+
+                    async def revalidated(self, engine, msg):
+                        snap = self.frontier
+                        await engine.submit_verify(msg)
+                        if self.frontier != snap:
+                            return
+                        self.frontier = snap + 1
+
+                    async def rhs_rereads(self, sleeper):
+                        snap = self.frontier
+                        await sleeper()
+                        self.frontier = self.frontier + (snap and 1)
+
+                    async def other_loop(self, sleeper):
+                        while True:
+                            self.frontier += 1
+                            await sleeper()
+                """,
+        },
+    )
+    messages = [f.render() for f in await_interference.check(sf)]
+    assert len(messages) == 1, messages
+    assert "read-modify-write of self.frontier" in messages[0]
+    assert "on_frame" in messages[0]
+
+
+@pytest.mark.hbrace
+def test_await_interference_skips_single_coroutine_state(tmp_path):
+    """An attribute only ONE coroutine ever touches has no interference
+    partner: the RMW is single-writer and stays silent."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/solo.py": """\
+                class Solo:
+                    def __init__(self):
+                        self.cursor = 0
+
+                    async def only_user(self, sleeper):
+                        snap = self.cursor
+                        await sleeper()
+                        self.cursor = snap + 1
+                """,
+        },
+    )
+    assert [f.render() for f in await_interference.check(sf)] == []
+
+
+@pytest.mark.hbrace
+def test_await_interference_registry_guard(tmp_path, monkeypatch):
+    """A declared AWAIT_RMW_GUARDS entry silences the finding; a stale
+    entry naming a vanished function is itself a finding."""
+    files = {
+        "net/bad.py": """\
+            class Handler:
+                def __init__(self):
+                    self.frontier = 0
+
+                async def on_frame(self, engine, msg):
+                    snap = self.frontier
+                    await engine.submit_verify(msg)
+                    self.frontier = snap + 1
+
+                async def other_loop(self, sleeper):
+                    while True:
+                        self.frontier += 1
+                        await sleeper()
+            """,
+    }
+    sf = make_pkg(tmp_path, files)
+    monkeypatch.setitem(
+        registry.AWAIT_RMW_GUARDS,
+        "net/bad.py::Handler.on_frame::frontier",
+        "single writer: other_loop is gated off while on_frame runs",
+    )
+    assert [f.render() for f in await_interference.check(sf)] == []
+    monkeypatch.setitem(
+        registry.AWAIT_RMW_GUARDS,
+        "net/bad.py::Handler.vanished::attr",
+        "stale",
+    )
+    messages = [f.render() for f in await_interference.check(sf)]
+    assert any("no longer exists" in m for m in messages)
+
+
+@pytest.mark.hbrace
+def test_blocking_in_async_fires_on_known_bad(tmp_path):
+    """time.sleep reached transitively from a coroutine, a raw open()
+    in an async body, and an eager submit-future .result() all fire."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/bad.py": """\
+                import time
+
+
+                def slow_helper():
+                    time.sleep(1.0)
+
+
+                async def tick():
+                    slow_helper()
+
+
+                async def snapshot(path):
+                    with open(path) as fh:
+                        return fh.read()
+
+
+                async def fetch(engine, jobs):
+                    fut = engine.submit_msm(jobs)
+                    return fut.result()
+                """,
+        },
+    )
+    messages = [f.render() for f in blocking_async.check(sf)]
+    assert any(
+        "time.sleep()" in m and "'slow_helper'" in m for m in messages
+    ), messages
+    assert any("open()" in m and "'snapshot'" in m for m in messages)
+    assert any(".result() on a submit_* future" in m for m in messages)
+
+
+@pytest.mark.hbrace
+def test_blocking_in_async_run_in_executor_is_clean(tmp_path):
+    """A callable handed to run_in_executor creates no call edge: the
+    offloaded helper's blocking body is exempt by construction."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/ok.py": """\
+                import asyncio
+                import time
+
+
+                def slow_helper():
+                    time.sleep(1.0)
+
+
+                async def tick():
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(None, slow_helper)
+                """,
+        },
+    )
+    assert [f.render() for f in blocking_async.check(sf)] == []
+
+
+@pytest.mark.hbrace
+def test_blocking_in_async_declared_boundary(tmp_path, monkeypatch):
+    """A declared executor-offload boundary stops traversal; a stale
+    boundary entry is a finding."""
+    files = {
+        "net/bound.py": """\
+            import os
+
+
+            def persist(path, blob):
+                fd = os.open(path, 0)
+                os.fsync(fd)
+
+
+            class Node:
+                def _persist(self, path, blob):
+                    persist(path, blob)
+
+                async def on_commit(self, path, blob):
+                    self._persist(path, blob)
+            """,
+    }
+    sf = make_pkg(tmp_path, files)
+    assert any(
+        "os.fsync" in f.render() for f in blocking_async.check(sf)
+    )
+    monkeypatch.setitem(
+        registry.EXECUTOR_OFFLOAD_BOUNDARIES,
+        "net/bound.py::Node._persist",
+        "test boundary: ships the fsync to the executor",
+    )
+    assert [f.render() for f in blocking_async.check(sf)] == []
+    monkeypatch.setitem(
+        registry.EXECUTOR_OFFLOAD_BOUNDARIES,
+        "net/bound.py::Node.vanished",
+        "stale",
+    )
+    assert any(
+        "no longer exists" in f.render() for f in blocking_async.check(sf)
+    )
+
+
+@pytest.mark.hbrace
+def test_clock_domain_mixed_subtraction_fires(tmp_path):
+    sf = make_pkg(
+        tmp_path,
+        {
+            "sim/bad.py": """\
+                import time
+
+
+                def mixed():
+                    t0 = time.perf_counter()
+                    t1 = time.time()
+                    return t1 - t0
+
+
+                def clean():
+                    t0 = time.perf_counter()
+                    return time.perf_counter() - t0
+                """,
+        },
+    )
+    messages = [f.render() for f in clock_domain.check(sf)]
+    assert len(messages) == 1, messages
+    assert "mixes clock domains 'wall' and 'mono'" in messages[0]
+
+
+@pytest.mark.hbrace
+def test_clock_domain_skewed_freshness_and_feed_fallback(
+    tmp_path, monkeypatch
+):
+    """The round-14 supervisor bug class: a skewed feed stamp in a
+    freshness decision, and a .get() fallback that joins two domains
+    before the subtraction."""
+    files = {
+        "sup.py": """\
+            import time
+
+
+            def health(row):
+                now = time.time()
+                return now - row["t"]
+
+
+            def age_with_fallback(row):
+                now = time.time()
+                return now - row.get("t_host", row["t"])
+            """,
+    }
+    sf = make_pkg(tmp_path, files)
+    monkeypatch.setattr(
+        registry, "CLOCK_FEED_CONSUMERS", ("sup.py",)
+    )
+    monkeypatch.setitem(
+        registry.CLOCK_FRESHNESS_FUNCS,
+        "sup.py::health",
+        "test freshness decider",
+    )
+    messages = [f.render() for f in clock_domain.check(sf)]
+    assert any(
+        "skewed node time (skewed-wall) feeds the freshness" in m
+        for m in messages
+    ), messages
+    assert any("joining two clock domains" in m for m in messages)
+
+
+@pytest.mark.hbrace
+def test_clock_domain_persisted_monotonic_fires(tmp_path, monkeypatch):
+    files = {
+        "persist.py": """\
+            import time
+
+
+            def black_box():
+                return {"t_mono": time.monotonic(), "n": 3}
+            """,
+    }
+    sf = make_pkg(tmp_path, files)
+    monkeypatch.setitem(
+        registry.CLOCK_PERSIST_FUNCS,
+        "persist.py::black_box",
+        "test persistence payload",
+    )
+    messages = [f.render() for f in clock_domain.check(sf)]
+    assert any(
+        "monotonic timestamp (mono) persisted under 't_mono'" in m
+        for m in messages
+    ), messages
+
+
+@pytest.mark.hbrace
+def test_clock_domain_bypass_fires_in_net_scope_only(tmp_path):
+    """A raw OS-clock read inside net/ bypasses the node seams; the
+    same read outside the scoped planes is silent (harness tiers own
+    their clocks)."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/badclock.py": """\
+                import asyncio
+                import time
+
+
+                def tick(self):
+                    return time.monotonic()
+
+
+                async def tock(self):
+                    # the named-binding form the transcript-cooldown
+                    # regression used: must be seen like the chained one
+                    loop = asyncio.get_running_loop()
+                    return loop.time()
+                """,
+            "bench_like.py": """\
+                import time
+
+
+                def tick():
+                    return time.monotonic()
+                """,
+        },
+    )
+    messages = [f.render() for f in clock_domain.check(sf)]
+    assert len(messages) == 2, messages
+    assert all("net/badclock.py" in m for m in messages)
+    assert all("bypasses the node clock seams" in m for m in messages)
+    assert any("loop.time" in m for m in messages)
+
+
+@pytest.mark.hbrace
+def test_clock_domain_stale_registry_entry_fires(tmp_path, monkeypatch):
+    sf = make_pkg(tmp_path, {"mod.py": "X = 1\n"})
+    monkeypatch.setitem(
+        registry.CLOCK_INJECTION_POINTS,
+        "mod.py::vanished",
+        "stale",
+    )
+    assert any(
+        "no longer exists" in f.render() for f in clock_domain.check(sf)
+    )
+
+
+@pytest.mark.hbrace
+def test_task_retention_fires_on_known_bad(tmp_path):
+    sf = make_sf(
+        tmp_path,
+        "net/bad_tasks.py",
+        """\
+        import asyncio
+
+        def spawn_and_forget(self, coro, coro2, coro3):
+            asyncio.create_task(coro)
+            t = asyncio.create_task(coro2)
+            kept = asyncio.create_task(coro3)
+            self._tasks.append(kept)
+            return None
+        """,
+    )
+    messages = [f.message for f in task_retention.check(sf)]
+    assert len(messages) == 2, messages
+    assert any("fire-and-forget create_task" in m for m in messages)
+    assert any("task handle 't'" in m for m in messages)
+    # the retained handle is silent
+    assert not any("'kept'" in m for m in messages)
+
+
+@pytest.mark.hbrace
+def test_task_retention_repo_idioms_are_clean():
+    """The package's own spawn sites all retain their handles (the
+    satellite audit: self._tasks append, done-callback-pruned sets,
+    closure lists)."""
+    findings = []
+    for sf in lint.iter_sources():
+        findings.extend(task_retention.check(sf))
+    assert findings == [], [f.render() for f in findings]
+
+
+# -- hbrace: coroutine-reachability pins on the real callgraph ----------------
+
+
+@pytest.mark.hbrace
+def test_reachability_resolves_create_task_and_dhb_hook():
+    """Coroutine reachability must flow through asyncio.create_task
+    spawns (start() -> _wire_retry_loop -> _cull_stalled_handshakes)
+    and into the consensus core through the dhb slot every install
+    path routes through _wrap_dhb."""
+    g = callgraph.build(PACKAGE_ROOT)
+    reach = reachable_map(
+        g, boundaries=tuple(registry.EXECUTOR_OFFLOAD_BOUNDARIES)
+    )
+    cull = reach["net/node.py::Hydrabadger._cull_stalled_handshakes"]
+    assert "net/node.py::Hydrabadger._wire_retry_loop" in cull
+    handle = reach[
+        "consensus/dynamic_honey_badger.py::DynamicHoneyBadger.handle_message"
+    ]
+    assert "net/node.py::Hydrabadger._handler_loop" in handle
+    # the flight plane: the dump BOUNDARY is reachable, the offloaded
+    # fsync half and the checkpoint store behind _persist_checkpoint
+    # are not — the declared boundaries genuinely stop traversal
+    assert "obs/flight.py::FlightRecorder.dump" in reach
+    assert "obs/flight.py::FlightRecorder._write" not in reach
+    assert "checkpoint.py::CheckpointStore.save" not in reach
+
+
+@pytest.mark.hbrace
+def test_reachability_resolves_gather_fanout(tmp_path):
+    """asyncio.gather(work_a(), work_b()) spawns both coroutines: the
+    inner calls are ordinary call sites, so reachability follows."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/fan.py": """\
+                import asyncio
+                import time
+
+
+                async def work_a():
+                    time.sleep(0.1)
+
+
+                async def work_b():
+                    pass
+
+
+                async def main():
+                    await asyncio.gather(work_a(), work_b())
+                """,
+        },
+    )
+    messages = [f.render() for f in blocking_async.check(sf)]
+    assert any(
+        "time.sleep()" in m and "'work_a'" in m for m in messages
+    ), messages

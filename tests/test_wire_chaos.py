@@ -628,24 +628,42 @@ async def test_equivocating_peer_detected_over_tcp():
 
 
 @pytest.mark.asyncio
-async def test_stalled_handshake_culled(monkeypatch):
+async def test_stalled_handshake_culled():
     """A connection whose hello/welcome was lost in flight (the chaos
     plane's signature failure mode) is aborted after the handshake
-    timeout instead of parking verified frames forever."""
-    from hydrabadger_tpu.net import node as node_mod
+    timeout instead of parking verified frames forever.
 
-    monkeypatch.setattr(node_mod, "HANDSHAKE_TIMEOUT_S", 0.3)
+    Deflaked (round 15): the timeout is crossed by ADVANCING the node's
+    injected ``_mono_base`` ruler — the real 5 s constant, no shrunken
+    wall-clock window racing host load.  ``peer.born`` is stamped from
+    the same node clock, so the cull subtraction is exact."""
+    from conftest import FakeMono
+    from hydrabadger_tpu.net.node import HANDSHAKE_TIMEOUT_S
+
     node = Hydrabadger(InAddr("127.0.0.1", BASE_PORT + 95), fast_config())
+    fake = FakeMono(t0=500.0)
+    node._mono_base = fake  # before any connection: born stamps ride it
     await node.start([], gen_txns)
     try:
         reader, writer = await asyncio.open_connection(
             "127.0.0.1", BASE_PORT + 95
         )
-        # never send a hello: the node must cull us, not wait forever
+        # never send a hello; wait only for the ACCEPT to register
+        assert await wait_for(lambda: len(node.peers.by_addr) >= 1)
+        # just under the timeout: the sweep must NOT cull (the boundary
+        # is exact on the fake clock, so call the sweep directly)
+        fake.advance(HANDSHAKE_TIMEOUT_S - 0.1)
+        node._cull_stalled_handshakes()
+        assert node.metrics.counter("handshake_timeouts").value == 0
+        # past it: the BACKGROUND wire-retry tick must run the cull on
+        # its own — this pins the sweep wiring end to end, not just the
+        # method; no race, because the fake clock is already past the
+        # timeout so any tick (0.25 s cadence) culls
+        fake.advance(0.2)
         assert await wait_for(
             lambda: node.metrics.counter("handshake_timeouts").value >= 1,
-            timeout=5,
-        )
+            timeout=10,
+        ), "wire-retry tick never swept the stalled handshake"
         assert await wait_for(lambda: reader.at_eof(), timeout=5)
         writer.close()
     finally:

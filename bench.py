@@ -1349,6 +1349,164 @@ def _trace_overhead_config15(epochs: int = 5, legs: int = 3) -> dict:
     }
 
 
+def _era_age_config16(n_nodes: int = 64, eras: int = 3,
+                      steady_epochs: int = 3) -> dict:
+    """Round-16 era-age row (hbstate): a DynamicHoneyBadger topology
+    crosses `eras` era switches back-to-back and the row pins steady
+    epoch time FLAT across era index — the config-5 era-age slowdown
+    (validators retransmitting their whole pending_kg backlog until
+    committed, with `_commit_keygen_msg` re-freezing, re-reconstructing
+    and re-handling every duplicate: 64512 acks/epoch handled at 64
+    nodes when only ~4k unique exist) is dead, and this row is the
+    regression tripwire.  The worst later-era steady p50 must stay
+    within 1.2x the era-0 steady p50 (+ a small jitter floor at CI
+    scale), and the per-epoch state census (obs/census.py) must read
+    flat for every per_epoch/per_era container across the whole run.
+
+    Attribution rides the row like config-5: a traced 16-node
+    python-core leg supplies the straggler node + gating stage + msg
+    latency (the native-ACS main run has no message plane to trace),
+    and the hand-recorded pre-fix switch walls sit beside the live
+    capture so the before/after is auditable in one place."""
+    import time as _time
+
+    from hydrabadger_tpu.obs.census import flatness_violations
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    # traced python-core attribution leg (same topology class as
+    # config-5's vs_baseline denominator)
+    tl_net = SimNetwork(
+        SimConfig(n_nodes=16, protocol="dhb", txns_per_node_per_epoch=4,
+                  txn_bytes=2, seed=7, native_acs=False, trace=True)
+    )
+    tl_net.run(2)
+    timeline = tl_net.timeline_report() or {}
+    tl_net.shutdown()
+
+    def _p50(walls: list) -> float:
+        ordered = sorted(walls)
+        return ordered[len(ordered) // 2]
+
+    t_total0 = _time.perf_counter()
+    net = SimNetwork(
+        SimConfig(
+            n_nodes=n_nodes, protocol="dhb",
+            txns_per_node_per_epoch=max(1, 512 // n_nodes), txn_bytes=2,
+            seed=0,
+        )
+    )
+    t0 = _time.perf_counter()
+    net.run(1)  # bootstrap epoch excluded from every p50
+    bootstrap_epoch_s = _time.perf_counter() - t0
+    era_walls: list = [[]]  # steady per-epoch walls, one list per era
+    switch_walls: list = []  # per-epoch walls through each switch
+    switch_epochs: list = []
+    for _ in range(steady_epochs):
+        t0 = _time.perf_counter()
+        m = net.run(1)
+        era_walls[0].append(round(_time.perf_counter() - t0, 2))
+    assert m.agreement_ok
+    census_era0 = net.census.latest()
+    victims = list(net.ids[-eras:])
+    for k, victim in enumerate(victims):
+        gone = set(victims[:k])
+        watchers = [
+            nid for nid in net.ids
+            if nid != victim and nid not in gone
+            and net.nodes[nid].is_validator
+        ]
+        # era = start-epoch index, not a counter: detect the flip as a
+        # CHANGE from the pre-vote snapshot (config-5 watches `era > 0`,
+        # which is only right for the FIRST switch)
+        era_before = {nid: net.nodes[nid].era for nid in watchers}
+        for nid in watchers:
+            net.router.dispatch_step(
+                nid, net.nodes[nid].vote_to_remove(victim)
+            )
+        walls = []
+        switched_at = None
+        for i in range(24):
+            t0 = _time.perf_counter()
+            m = net.run(1)
+            walls.append(round(_time.perf_counter() - t0, 2))
+            assert m.agreement_ok, f"config16: agreement, switch {k + 1}"
+            if all(
+                net.nodes[nid].era != era_before[nid] for nid in watchers
+            ):
+                switched_at = i + 1
+                break
+        assert switched_at is not None, (
+            f"config16: era switch {k + 1}/{eras} never completed"
+        )
+        switch_walls.append(walls)
+        switch_epochs.append(switched_at)
+        era_walls.append([])
+        for _ in range(steady_epochs):
+            t0 = _time.perf_counter()
+            m = net.run(1)
+            era_walls[-1].append(round(_time.perf_counter() - t0, 2))
+        assert m.agreement_ok, f"config16: agreement, era {k + 1} steady"
+    census_final = net.census.latest()
+    era_gap = net.era_gap_snapshot()
+    net.shutdown()
+
+    p50s = [round(_p50(w), 4) for w in era_walls]
+    # jitter floor: at CI scale (16-node smokes) steady epochs are
+    # sub-second and a 1.2x ratio alone would trip on scheduler noise;
+    # at bench scale (64 nodes, ~55 s epochs) the ratio dominates
+    bound = max(1.2 * p50s[0], p50s[0] + 0.75)
+    worst = max(p50s[1:])
+    assert worst <= bound, (
+        f"config16: era-age slowdown is back — later-era steady p50 "
+        f"{worst:.2f}s exceeds {bound:.2f}s (era-0 p50 {p50s[0]:.2f}s); "
+        f"per-era p50s {p50s}"
+    )
+    leaks = flatness_violations(census_era0, census_final)
+    assert not leaks, f"config16: scoped state grew across eras: {leaks}"
+    return {
+        "metric": f"dhb_era_age_steady_p50_ratio_{n_nodes}node_{eras}era",
+        "value": round(worst / p50s[0], 4) if p50s[0] else 0.0,
+        "unit": (
+            "worst later-era / era-0 steady epoch p50 (<= 1.2 asserted, "
+            "small-epoch jitter floor aside)"
+        ),
+        "eras_crossed": eras,
+        "era_steady_p50_s": p50s,
+        "era_steady_walls_s": era_walls,
+        "era_switch_walls_s": switch_walls,
+        "era_switch_epochs": switch_epochs,
+        "bootstrap_epoch_s": round(bootstrap_epoch_s, 1),
+        "census_flat": True,
+        "census_era0": census_era0,
+        "census_final": census_final,
+        "era_commit_gap_s": era_gap["era_commit_gap_s"],
+        "steady_epoch_p50_s": era_gap["steady_epoch_p50_s"],
+        "shadow_dkg": era_gap["shadow_dkg"],
+        "shadow_dkg_stall_epochs": era_gap["shadow_dkg_stall_epochs"],
+        "device_backend": era_gap["device_backend"],
+        "device_overlap_has_device": era_gap["device_overlap_has_device"],
+        # attribution leg (config-5 provenance idiom)
+        "epoch_critical_stage": timeline.get("epoch_critical_stage"),
+        "straggler_node": timeline.get("straggler_node"),
+        "msg_latency_p99_s": timeline.get("msg_latency_p99_s"),
+        "commit_spread_max_s": timeline.get("commit_spread_max_s"),
+        "timeline_source": "python_core_calibration_leg_16node",
+        # before/after: the pre-fix 64-node capture (round 16, 4096-txn
+        # config-5 topology) whose keygen-window walls this row killed —
+        # the responsible structure, named
+        "pre_fix_switch_epoch_s": [69.2, 75.4, 74.7, 69.4, 87.5],
+        "pre_fix_steady_epoch_s": 53.6,
+        "fixed_stage": (
+            "dynamic_honey_badger._commit_keygen_msg duplicate "
+            "keygen-message recommit: pending_kg backlog retransmitted "
+            "every proposal and re-frozen/re-handled per duplicate; "
+            "killed by _KeyGenState.committed_seen dedup + one-pass "
+            "own-backlog filter in _on_batch"
+        ),
+        "total_wall_s": round(_time.perf_counter() - t_total0, 1),
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1356,7 +1514,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
@@ -1379,7 +1537,10 @@ def main(argv=None) -> int:
         "message plane; committed batches pinned point-identical), "
         "15 = tracing-overhead leg (spans-only vs spans+wire-event "
         "epochs/s, both traced, on the 16-node message plane; the "
-        "cluster-timeline wire-event stamps' increment must cost <5%%)",
+        "cluster-timeline wire-event stamps' increment must cost <5%%), "
+        "16 = era-age row (DHB crosses >= 3 era switches; later-era "
+        "steady epoch p50 must stay within 1.2x era 0 and the state "
+        "census must read flat — the config-5 era-age tripwire)",
     )
     p.add_argument(
         "--rbc",
@@ -1497,6 +1658,15 @@ def main(argv=None) -> int:
             # cluster-timeline wire-event stamps under their 5% budget
             ("config15_trace_overhead",
              lambda: _trace_overhead_config15(epochs_or(5)), "always"),
+            # era-age tripwire: 3 back-to-back era switches at the
+            # config-5 topology — heavy (~25 min at 64 nodes on the
+            # native ACS engine), so it rides the full capture tier
+            # like config 5; CI covers the same contract at 16 nodes
+            # through the soak gate (sim/soak.py --era-only)
+            ("config16_era_age",
+             lambda: _era_age_config16(args.nodes, eras=3,
+                                       steady_epochs=epochs_or(3)),
+             "tpu"),
         ]
         jax_ok = not probe.get("error")
         backend_lost = False
@@ -1637,6 +1807,12 @@ def main(argv=None) -> int:
         )
     if args.config == 15:
         return single(lambda: _trace_overhead_config15(epochs_or(5)))
+    if args.config == 16:
+        return single(
+            lambda: _era_age_config16(
+                args.nodes, eras=3, steady_epochs=epochs_or(3)
+            )
+        )
 
     # config 3 (also the fall-through for the bare invocation)
     return single(_rs_throughput_config3)

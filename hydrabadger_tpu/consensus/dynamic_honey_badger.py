@@ -145,6 +145,13 @@ class _KeyGenState:
     # distinct current-era validators whose committed ("cutover", era)
     # marker we have seen; the era flips when > f of them exist
     cutover_votes: set = dataclasses.field(default_factory=set)
+    # deep-frozen (proposer, msg) pairs already committed this era —
+    # validators retransmit their whole pending_kg backlog until they see
+    # it committed, so late keygen epochs would otherwise re-reconstruct,
+    # re-transcript and re-handle the same acks thousands of times (the
+    # config-5 era-age slowdown); O(1) membership here caps the transcript
+    # at unique messages and makes duplicate commits free
+    committed_seen: set = dataclasses.field(default_factory=set)
     cutover_sent: bool = False
     # pre-generated (pk_set, sk_share) once sealed + fully settled, so
     # the cutover batch installs the new era in O(1) crypto
@@ -522,6 +529,7 @@ class DynamicHoneyBadger:
         contributions = {}
         batch_votes: List[Tuple] = []  # (proposer, vote) in commit order
         kg_parts: List[Tuple] = []  # (proposer, Part) deferred to one flush
+        own_committed: set = set()  # our keygen msgs seen committed
         kg_state = self.key_gen  # the keygen receiving this batch's msgs
         kg_tlen = len(kg_state.transcript) if kg_state is not None else 0
         for proposer, payload in sorted(hb_batch.contributions.items()):
@@ -547,11 +555,15 @@ class DynamicHoneyBadger:
             for kg in kg_msgs:
                 if proposer == self.our_id:
                     # our own keygen msg committed: stop retransmitting it
-                    kg_t = _freeze(kg)
-                    self.pending_kg = [
-                        m for m in self.pending_kg if _freeze(m) != kg_t
-                    ]
+                    own_committed.add(_freeze(kg))
                 self._commit_keygen_msg(proposer, kg, step, kg_parts)
+        if own_committed and self.pending_kg:
+            # one pass over the backlog per batch — the per-message spelling
+            # re-froze the whole backlog for every own committed message
+            # (O(own x pending) _freeze calls per keygen epoch)
+            self.pending_kg = [
+                m for m in self.pending_kg if _freeze(m) not in own_committed
+            ]
         self._flush_keygen_parts(kg_parts, step)
         if kg_state is not None and len(kg_state.transcript) > kg_tlen:
             # batch-boundary marker: install_share_from_transcript
@@ -772,10 +784,25 @@ class DynamicHoneyBadger:
         if state is None:
             return  # no active keygen: stale message
         try:
-            frozen = tuple(kg)
+            frozen = _freeze(kg)
             kind = frozen[0]
         except (ValueError, TypeError, IndexError):
             step.fault(proposer, "dhb: malformed keygen message")
+            return
+        seen = getattr(state, "committed_seen", None)
+        if seen is None:
+            # resumed from a pre-dedup pickled snapshot: rebuild from the
+            # committed transcript so replayed retransmits stay free
+            seen = state.committed_seen = {
+                (p, _freeze(m)) for p, m in state.transcript
+            }
+        dedup_key = (proposer, frozen)
+        if dedup_key in seen:
+            # retransmitted duplicate of an already-committed message:
+            # validators re-ship their pending_kg backlog until they see
+            # it committed, so every keygen epoch past the first commits
+            # thousands of these at scale — skip before reconstruction,
+            # transcript append and handle_* (first commit did it all)
             return
         if kind == "cutover":
             # Era-cutover marker (round 9): a current-era validator's
@@ -790,6 +817,7 @@ class DynamicHoneyBadger:
             try:
                 if int(frozen[1]) == self.era:
                     state.cutover_votes.add(proposer)
+                    seen.add(dedup_key)
             except (ValueError, TypeError, IndexError):
                 step.fault(proposer, "dhb: malformed keygen message")
             return
@@ -806,6 +834,7 @@ class DynamicHoneyBadger:
             # attacker-SENT ("batch",) here would let one Byzantine
             # validator inject an early part-flush into every future
             # replayer's schedule and desync it from the live gate.
+            seen.add(dedup_key)
             state.transcript.append((proposer, frozen))
         try:
             if kind == "part":

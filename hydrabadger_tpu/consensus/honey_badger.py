@@ -122,6 +122,7 @@ class HoneyBadger:
         step.output.append(batch)
         self.epoch = epoch + 1
         self.epochs.pop(epoch, None)
+        self.has_input.pop(epoch, None)
         return step
 
     @guarded_handler("hb")
@@ -248,6 +249,7 @@ class HoneyBadger:
                 if epoch == self.epoch:
                     self.epoch = epoch + 1
                     self.epochs.pop(epoch, None)
+                    self.has_input.pop(epoch, None)
                     # replay messages that were beyond the window
                     if self.deferred:
                         pending, self.deferred = self.deferred, []

@@ -60,6 +60,19 @@ async-aware concurrency and clock-domain analyzer:
     a dropped handle is a GC-cancellation hazard
     (``lint/task_retention.py``).
 
+The hbstate pass (round 16) closes the era-lifecycle gap:
+
+  * **state-lifecycle** — every growing container attribute on a
+    node-lifetime class (``registry.STATE_SCOPE_CLASSES``) carries a
+    declared lifecycle in ``registry.STATE_LIFECYCLE`` — ``per_epoch``
+    (reset/evicted on the epoch commit path), ``per_era`` (cleared on
+    the era-flip path), ``bounded`` (recognized cap guard at every
+    growth site) or ``process_lifetime`` (justified) — and the
+    analyzer verifies each declaration over the callgraph; undeclared
+    monotonic growth and stale registry entries are findings
+    (``lint/state_lifecycle.py``).  The runtime twin is
+    ``obs/census.py``'s per-epoch state census.
+
 Everything the passes treat as special is declared in
 ``lint/registry.py`` — the auditable contract surface.
 
@@ -82,6 +95,7 @@ from __future__ import annotations
 
 import ast
 import re
+import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -166,7 +180,7 @@ def all_rules():
     from . import async_fetch, await_interference, blocking_async
     from . import clock_domain, deadcode, env_flags, jit_hygiene
     from . import limb_layout, mosaic, retrace_budget, sansio, secrets
-    from . import taint, task_retention, wire_contract
+    from . import state_lifecycle, taint, task_retention, wire_contract
 
     return [
         sansio,
@@ -183,6 +197,7 @@ def all_rules():
         blocking_async,
         clock_domain,
         task_retention,
+        state_lifecycle,
         deadcode,
     ]
 
@@ -196,6 +211,7 @@ def run_full(
     root: Path = PACKAGE_ROOT,
     rules: Optional[Sequence] = None,
     files: Optional[Sequence[Path]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[Finding], List[Tuple[Finding, str]]]:
     """Run ``rules`` over ``root`` (or explicit ``files``).
 
@@ -204,6 +220,9 @@ def run_full(
     (shown path, line): the dataflow passes emit findings for files
     other than the one they anchor on, and the pragma lives next to the
     flagged statement, wherever that is.
+
+    ``timings``, when given, accumulates per-rule wall seconds (keyed
+    by ``RULE``) across all files — the ``--timing`` report source.
     """
     selected = list(rules) if rules is not None else all_rules()
     sources = (
@@ -230,7 +249,14 @@ def run_full(
             applies = getattr(rule, "applies", None)
             if applies is not None and not applies(sf.relpath):
                 continue
+            t0 = time.perf_counter()
             raw.extend(rule.check(sf))
+            if timings is not None:
+                timings[rule.RULE] = (
+                    timings.get(rule.RULE, 0.0)
+                    + time.perf_counter()
+                    - t0
+                )
     for f in raw:
         just = index.get(f.path, {}).get(f.line, {}).get(f.rule)
         if just is not None:

@@ -148,6 +148,12 @@ def main(argv=None) -> int:
         "diagnostics",
     )
     parser.add_argument(
+        "--timing",
+        action="store_true",
+        help="report per-pass wall time and fail if the total exceeds "
+        "the CI budget (registry.LINT_TIME_BUDGET_S)",
+    )
+    parser.add_argument(
         "-q", "--quiet", action="store_true", help="suppress the summary line"
     )
     args = parser.parse_args(argv)
@@ -174,7 +180,8 @@ def main(argv=None) -> int:
                 print("hblint: no changed package files")
             return 0
 
-    findings, suppressed = run_full(rules=rules, files=files)
+    timings = {} if args.timing else None
+    findings, suppressed = run_full(rules=rules, files=files, timings=timings)
 
     if args.write_baseline is not None:
         if files is not None:
@@ -278,7 +285,31 @@ def main(argv=None) -> int:
             f"({len(suppressed)} suppressed with justification{extra}) "
             f"across {len(rules)} rule(s) in {PACKAGE_ROOT.name}/"
         )
-    return 1 if (fail_findings or new_suppressions) else 0
+    over_budget = False
+    if timings is not None:
+        from . import registry
+
+        total = sum(timings.values())
+        budget = registry.LINT_TIME_BUDGET_S
+        out = sys.stdout if not args.json else sys.stderr
+        print("hblint --timing: per-pass wall time", file=out)
+        for rule_name, secs in sorted(
+            timings.items(), key=lambda kv: -kv[1]
+        ):
+            print(f"  {rule_name:20s} {secs:7.2f}s", file=out)
+        print(
+            f"  {'TOTAL':20s} {total:7.2f}s  (budget {budget:.0f}s)",
+            file=out,
+        )
+        if total > budget:
+            over_budget = True
+            print(
+                f"hblint: TIME BUDGET EXCEEDED — {total:.1f}s > "
+                f"{budget:.0f}s (registry.LINT_TIME_BUDGET_S); profile "
+                "the slowest pass above before raising the budget",
+                file=sys.stderr,
+            )
+    return 1 if (fail_findings or new_suppressions or over_budget) else 0
 
 
 if __name__ == "__main__":

@@ -358,6 +358,11 @@ class SimNetwork:
         # LRU's key space (they fall through to the cores, whose fault
         # paths own unknown senders)
         self._dup_ids = frozenset(self.ids)
+        # per-epoch state census (obs/census.py): the runtime half of the
+        # hbstate lifecycle contract, sampled at every epoch boundary
+        from ..obs.census import StateCensus
+
+        self.census = StateCensus(metrics=self.metrics)
 
     def __setstate__(self, state):
         """Unpickle (checkpoint resume): default attributes added after a
@@ -383,6 +388,10 @@ class SimNetwork:
             ),
         )
         self.__dict__.setdefault("_steady_durations", [])
+        if "census" not in self.__dict__:
+            from ..obs.census import StateCensus
+
+            self.census = StateCensus(metrics=self.metrics)
         if getattr(self.router, "drain_hook", None) is None:
             self.router.drain_hook = self._drain_async
 
@@ -622,12 +631,27 @@ class SimNetwork:
         # HERE, at the tick boundary, not just in a teardown log line
         _futures.check_dropped()
 
+    def _census_sample(self) -> None:
+        """One state-census row per epoch: every node's consensus core
+        (unwrapped from any Byzantine shim), the network, the router."""
+        from ..obs.census import node_objects
+
+        objs: list = [self, self.router]
+        for nid in self.ids:
+            node = self.nodes[nid]
+            unwrap = getattr(node, "unwrap", None)
+            if unwrap is not None:
+                node = unwrap()
+            objs.extend(node_objects(node))
+        self.census.sample(objs, label=len(self.epoch_durations))
+
     def _run_epoch_inner(self) -> None:
         t0 = time.perf_counter()
         cfg = self.cfg
         if self._native_eligible():
             self._run_epoch_native()
             self.epoch_durations.append(time.perf_counter() - t0)
+            self._census_sample()
             return
         if cfg.protocol == "qhb":
             for nid in self.ids:
@@ -653,6 +677,7 @@ class SimNetwork:
         )
         self.router.run(budget)
         self.epoch_durations.append(time.perf_counter() - t0)
+        self._census_sample()
 
     def run(self, epochs: Optional[int] = None) -> SimMetrics:
         """Run `epochs` more epochs; metrics are lifetime-cumulative (all

@@ -215,22 +215,33 @@ def byz_soak(epochs: int = 200, n_nodes: int = 4,
 
 
 def era_soak(n_nodes: int = 16, steady_epochs: int = 6,
-             era_gap_floor_s: float = 2.0) -> Dict:
-    """Era-switch gate (round 9, shadow DKG): a dhb sim crosses >= 1
-    era with the shadow-DKG plane on and asserts the committed-epoch
-    gap across the switch stays bounded — the stop-the-world wall
-    (config-5's 181 s at 64 nodes) must not come back.
+             era_gap_floor_s: float = 2.0, eras: int = 2) -> Dict:
+    """Multi-era gate (rounds 9 + 16): a dhb sim crosses ``eras`` era
+    switches with the shadow-DKG plane on and asserts
 
-    The bound is ``max(2x steady-state p50, era_gap_floor_s)``: the 2x
-    relative target is the bench-scale claim (config-5 epochs carry
-    thousands of txns), while at CI scale the steady epochs are
-    milliseconds and the small absolute floor absorbs scheduler jitter
-    — both numbers are recorded in the row so the ratio is auditable.
-    Also asserts the switch actually happened, agreement held, and the
-    stall observable stayed SILENT (a loud stall during a healthy
-    switch would be a false alarm; a wedge would fail the switch
-    assertion).  Row fields carry device provenance: a CPU-only capture
-    of ``era_commit_gap_s`` cannot masquerade as a TPU recapture."""
+      * the committed-epoch gap across every switch stays bounded —
+        the stop-the-world wall (config-5's 181 s at 64 nodes) must
+        not come back.  The bound is ``max(2x steady-state p50,
+        era_gap_floor_s)``: the 2x relative target is the bench-scale
+        claim, while at CI scale the steady epochs are milliseconds
+        and the small absolute floor absorbs scheduler jitter;
+      * **era-age flatness** (hbstate, ROADMAP 5a): later-era steady
+        epoch time stays within 1.2x the era-0 steady p50 (plus the
+        same jitter floor) — an accumulating structure that makes
+        every era pay for every earlier one fails HERE, named;
+      * **state-census flatness**: every ``per_epoch``/``per_era``
+        container declared in lint/registry.py:STATE_LIFECYCLE is no
+        larger at the end of the last era than at the end of era 0
+        (obs/census.py's flatness contract — the runtime twin of the
+        state-lifecycle analyzer);
+      * every switch actually happened, agreement held throughout, and
+        the stall observable stayed SILENT (a loud stall during a
+        healthy switch would be a false alarm; a wedge fails the
+        switch assertion).
+
+    Row fields carry device provenance: a CPU-only capture of
+    ``era_commit_gap_s`` cannot masquerade as a TPU recapture."""
+    from ..obs.census import flatness_violations
     from .network import SimConfig, SimNetwork
 
     net = SimNetwork(
@@ -241,27 +252,56 @@ def era_soak(n_nodes: int = 16, steady_epochs: int = 6,
         )
     )
     net.run(steady_epochs)
-    victim = net.ids[-1]
-    for nid in net.ids:
-        if nid != victim:
+
+    def _p50(walls: List[float]) -> float:
+        ordered = sorted(walls)
+        return ordered[len(ordered) // 2]
+
+    # era-0 steady p50 over the LAST half of the warmup window: the
+    # first epochs pay one-time jit/codec cold-start and would inflate
+    # the baseline the era-age bound divides by
+    era0_walls = net.epoch_durations[steady_epochs // 2:]
+    steady_p50s = [_p50(era0_walls)]
+    census_base = net.census.latest()
+    victims = list(net.ids[-eras:])
+    switch_epochs: List[int] = []
+    m = None
+    for k, victim in enumerate(victims):
+        gone = set(victims[:k])
+        watchers = [
+            nid for nid in net.ids
+            if nid != victim and nid not in gone
+            and net.nodes[nid].is_validator
+        ]
+        # era = start-epoch index, NOT a counter: detect the flip as a
+        # CHANGE from the pre-vote snapshot, never as ``era >= k``
+        era_before = {nid: net.nodes[nid].era for nid in watchers}
+        for nid in watchers:
             net.router.dispatch_step(
                 nid, net.nodes[nid].vote_to_remove(victim)
             )
-    switched_at = None
-    m = None
-    for i in range(24):
-        m = net.run(1)
-        assert m.agreement_ok, "era soak lost agreement mid-switch"
-        if all(
-            net.nodes[nid].era > 0 for nid in net.ids if nid != victim
-        ):
-            switched_at = i + 1
-            break
-    assert switched_at is not None, (
-        "era never switched under shadow DKG (cutover wedged?)"
-    )
-    m = net.run(2)  # the NEW era commits steady epochs too
-    assert m.agreement_ok, "era soak lost agreement post-switch"
+        switched_at = None
+        for i in range(24):
+            m = net.run(1)
+            assert m.agreement_ok, (
+                f"era soak lost agreement mid-switch {k + 1}"
+            )
+            if all(
+                net.nodes[nid].era != era_before[nid] for nid in watchers
+            ):
+                switched_at = i + 1
+                break
+        assert switched_at is not None, (
+            f"era switch {k + 1}/{eras} never completed under shadow "
+            "DKG (cutover wedged?)"
+        )
+        switch_epochs.append(switched_at)
+        before = len(net.epoch_durations)
+        m = net.run(steady_epochs)  # the NEW era commits steady epochs
+        assert m.agreement_ok, (
+            f"era soak lost agreement post-switch {k + 1}"
+        )
+        steady_p50s.append(_p50(net.epoch_durations[before:]))
     net.shutdown()
     gap = net.era_gap_snapshot()
     bound = max(2.0 * gap["steady_epoch_p50_s"], era_gap_floor_s)
@@ -271,7 +311,23 @@ def era_soak(n_nodes: int = 16, steady_epochs: int = 6,
         f"{gap['steady_epoch_p50_s']:.3f}s) — the era-switch wall is "
         "back"
     )
-    # the stall detector must stay silent through a HEALTHY switch
+    # era-age flatness: an era must not pay for its predecessors
+    age_bound = max(1.2 * steady_p50s[0], steady_p50s[0] + era_gap_floor_s)
+    for era_idx, p50 in enumerate(steady_p50s[1:], start=1):
+        assert p50 <= age_bound, (
+            f"era-age slowdown: era {era_idx} steady p50 {p50:.3f}s "
+            f"exceeds the flatness bound {age_bound:.3f}s (era-0 p50 "
+            f"{steady_p50s[0]:.3f}s) — some per-era state is "
+            "accumulating; see the census row for the culprit"
+        )
+    # state-census flatness: per_epoch/per_era containers back at (or
+    # below) their era-0 levels once the last era's steady phase ends
+    census_end = net.census.latest()
+    leaks = flatness_violations(census_base, census_end)
+    assert not leaks, (
+        f"state census grew across eras for scoped containers: {leaks}"
+    )
+    # the stall detector must stay silent through HEALTHY switches
     stall_faults = [
         f for _nid, f in net.router.faults
         if "shadow keygen stalled" in f.kind
@@ -281,8 +337,15 @@ def era_soak(n_nodes: int = 16, steady_epochs: int = 6,
         "tier": f"era_switch_{n_nodes}node_shadow_dkg",
         "epochs": m.epochs_done,
         "epochs_per_sec": round(m.epochs_per_sec, 2),
-        "era_epochs_to_switch": switched_at,
+        "eras_crossed": eras,
+        "era_epochs_to_switch": switch_epochs[0],
+        "era_switch_epochs": switch_epochs,
+        "era_steady_p50_s": [round(p, 4) for p in steady_p50s],
+        "era_age_bound_s": round(age_bound, 4),
         "era_gap_bound_s": round(bound, 4),
+        "census_era0": census_base,
+        "census_final": census_end,
+        "census_flat": True,
         **gap,
         "agreement_ok": True,
     }

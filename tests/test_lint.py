@@ -24,6 +24,7 @@ from hydrabadger_tpu.lint import (
     retrace_budget,
     sansio,
     secrets,
+    state_lifecycle,
     taint,
     task_retention,
     wire_contract,
@@ -1182,3 +1183,312 @@ def test_reachability_resolves_gather_fanout(tmp_path):
     assert any(
         "time.sleep()" in m and "'work_a'" in m for m in messages
     ), messages
+
+
+# -- hbstate: state-lifecycle fixtures (round 16) ----------------------------
+#
+# Each known-bad package gets its OWN scope/lifecycle tables via
+# monkeypatch so the fixtures exercise exactly one lifecycle class each:
+# undeclared growth, a per_era attr never reset on the era-flip path, a
+# fake cap guarding the wrong direction, and stale registry entries.
+
+
+def _patch_state_tables(monkeypatch, scope=(), lifecycle=None,
+                        era_anchors=(), epoch_anchors=()):
+    monkeypatch.setattr(registry, "STATE_SCOPE_CLASSES", tuple(scope))
+    monkeypatch.setattr(registry, "STATE_LIFECYCLE", dict(lifecycle or {}))
+    monkeypatch.setattr(registry, "ERA_FLIP_ANCHORS", tuple(era_anchors))
+    monkeypatch.setattr(registry, "EPOCH_COMMIT_ANCHORS",
+                        tuple(epoch_anchors))
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_undeclared_growth_fires(tmp_path, monkeypatch):
+    """A node-lifetime container with a growth site and no registry
+    lifecycle is the base finding; declaring it silences."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "consensus/bad.py": """\
+                class Core:
+                    def __init__(self):
+                        self.ledger = []
+
+                    def handle(self, msg):
+                        self.ledger.append(msg)
+                """,
+        },
+    )
+    _patch_state_tables(
+        monkeypatch, scope=("consensus/bad.py::Core",), lifecycle={}
+    )
+    messages = [f.render() for f in state_lifecycle.check(sf)]
+    assert any(
+        "undeclared state growth: Core.ledger" in m for m in messages
+    ), messages
+    monkeypatch.setitem(
+        registry.STATE_LIFECYCLE,
+        "consensus/bad.py::Core.ledger",
+        ("process_lifetime", "fixture: audited unbounded"),
+    )
+    assert [f.render() for f in state_lifecycle.check(sf)] == []
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_per_era_reset_on_flip_path(tmp_path, monkeypatch):
+    """A per_era attr whose reset is NOT reachable from the era-flip
+    anchors fires; clearing it inside the flip path silences — the
+    reachability is over the callgraph, not same-function."""
+    bad = """\
+        class Core:
+            def __init__(self):
+                self.votes = {}
+
+            def handle_vote(self, sender, v):
+                self.votes[sender] = v
+
+            def _switch_era(self):
+                pass
+        """
+    good = """\
+        class Core:
+            def __init__(self):
+                self.votes = {}
+
+            def handle_vote(self, sender, v):
+                self.votes[sender] = v
+
+            def _switch_era(self):
+                self._rollover()
+
+            def _rollover(self):
+                self.votes = {}
+        """
+    for code, expect_finding in ((bad, True), (good, False)):
+        pkg = tmp_path / ("era_bad" if expect_finding else "era_good")
+        pkg.mkdir()
+        sf = make_pkg(pkg, {"consensus/core.py": code})
+        _patch_state_tables(
+            monkeypatch,
+            scope=("consensus/core.py::Core",),
+            lifecycle={"consensus/core.py::Core.votes": ("per_era", None)},
+            era_anchors=("consensus/core.py::Core._switch_era",),
+        )
+        messages = [f.render() for f in state_lifecycle.check(sf)]
+        if expect_finding:
+            assert any(
+                "per_era state Core.votes is never" in m for m in messages
+            ), messages
+        else:
+            assert messages == [], messages
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_per_epoch_eviction_counts(tmp_path, monkeypatch):
+    """Per-key eviction (``pop``) on the commit path satisfies
+    per_epoch — a full ``clear()`` is not required; with no commit-path
+    anchor reaching it, the same code fires."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "consensus/hb.py": """\
+                class Badger:
+                    def __init__(self):
+                        self.epochs = {}
+
+                    def handle(self, e, msg):
+                        self.epochs[e] = msg
+
+                    def _on_commit(self, e):
+                        self.epochs.pop(e, None)
+
+                    def _unrelated(self):
+                        pass
+                """,
+        },
+    )
+    table = {"consensus/hb.py::Badger.epochs": ("per_epoch", None)}
+    _patch_state_tables(
+        monkeypatch,
+        scope=("consensus/hb.py::Badger",),
+        lifecycle=table,
+        epoch_anchors=("consensus/hb.py::Badger._on_commit",),
+    )
+    assert [f.render() for f in state_lifecycle.check(sf)] == []
+    _patch_state_tables(
+        monkeypatch,
+        scope=("consensus/hb.py::Badger",),
+        lifecycle=table,
+        epoch_anchors=("consensus/hb.py::Badger._unrelated",),
+    )
+    messages = [f.render() for f in state_lifecycle.check(sf)]
+    assert any(
+        "per_epoch state Badger.epochs is never" in m for m in messages
+    ), messages
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_fake_cap_wrong_direction_fires(
+    tmp_path, monkeypatch
+):
+    """``if len(x) > CAP: x.append(v)`` grows exactly when already over
+    the cap — a fake guard hbtaint's direction-blind check would bless.
+    The admission direction (``len(x) < CAP``) and the trim idiom
+    (grow, then ``if len(x) > CAP: popitem``) both silence."""
+    fake = """\
+        class Node:
+            def __init__(self):
+                self.log = []
+
+            def note(self, item):
+                if len(self.log) > 16:
+                    self.log.append(item)
+        """
+    admission = """\
+        class Node:
+            def __init__(self):
+                self.log = []
+
+            def note(self, item):
+                if len(self.log) < 16:
+                    self.log.append(item)
+        """
+    trim = """\
+        class Node:
+            def __init__(self):
+                self.log = {}
+
+            def note(self, key, item):
+                self.log[key] = item
+                while len(self.log) > 16:
+                    self.log.pop(next(iter(self.log)))
+        """
+    for name, code, expect_finding in (
+        ("fake", fake, True), ("admission", admission, False),
+        ("trim", trim, False),
+    ):
+        pkg = tmp_path / name
+        pkg.mkdir()
+        sf = make_pkg(pkg, {"net/node.py": code})
+        _patch_state_tables(
+            monkeypatch,
+            scope=("net/node.py::Node",),
+            lifecycle={"net/node.py::Node.log": ("bounded", "16")},
+        )
+        messages = [f.render() for f in state_lifecycle.check(sf)]
+        if expect_finding:
+            assert any(
+                "declared bounded(16)" in m
+                and "no recognized cap guard" in m
+                for m in messages
+            ), (name, messages)
+        else:
+            assert messages == [], (name, messages)
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_stale_entries_fire(tmp_path, monkeypatch):
+    """Registry rot is itself a finding: a lifecycle entry naming a
+    vanished attr, and a scope entry naming a vanished class."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "consensus/core.py": """\
+                class Core:
+                    def __init__(self):
+                        self.kept = []
+                """,
+        },
+    )
+    _patch_state_tables(
+        monkeypatch,
+        scope=("consensus/core.py::Core", "consensus/gone.py::Vanished"),
+        lifecycle={
+            "consensus/core.py::Core.dropped": ("per_epoch", None),
+        },
+    )
+    messages = [f.render() for f in state_lifecycle.check(sf)]
+    assert any(
+        "stale STATE_LIFECYCLE entry: Core.dropped" in m for m in messages
+    ), messages
+    assert any(
+        "stale STATE_SCOPE_CLASSES entry" in m and "Vanished" in m
+        for m in messages
+    ), messages
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_process_lifetime_needs_justification(
+    tmp_path, monkeypatch
+):
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/node.py": """\
+                class Node:
+                    def __init__(self):
+                        self.batches = {}
+
+                    def commit(self, e, b):
+                        self.batches[e] = b
+                """,
+        },
+    )
+    _patch_state_tables(
+        monkeypatch,
+        scope=("net/node.py::Node",),
+        lifecycle={"net/node.py::Node.batches": ("process_lifetime", "")},
+    )
+    messages = [f.render() for f in state_lifecycle.check(sf)]
+    assert any("no justification" in m for m in messages), messages
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_drain_swap_is_a_reset(tmp_path, monkeypatch):
+    """``pending, self.q = self.q, []`` then conditional re-append is
+    the repo's drain-requeue idiom — a reset plus cap-preserving
+    refill, not unbounded growth."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "net/node.py": """\
+                class Node:
+                    def __init__(self):
+                        self.q = []
+
+                    def tick(self):
+                        pending, self.q = self.q, []
+                        for item in pending:
+                            if not self._send(item):
+                                self.q.append(item)
+
+                    def _send(self, item):
+                        return True
+                """,
+        },
+    )
+    _patch_state_tables(
+        monkeypatch,
+        scope=("net/node.py::Node",),
+        lifecycle={"net/node.py::Node.q": ("bounded", "drain-requeue")},
+    )
+    assert [f.render() for f in state_lifecycle.check(sf)] == []
+
+
+@pytest.mark.hbstate
+def test_state_lifecycle_repo_registry_is_live():
+    """Every registry table the pass consumes exists, every declared
+    lifecycle is a known one, and every entry's class is in scope —
+    the tables cannot silently rot."""
+    scoped = set(registry.STATE_SCOPE_CLASSES)
+    assert scoped, "STATE_SCOPE_CLASSES must not be empty"
+    for full, decl in registry.STATE_LIFECYCLE.items():
+        cls_key = full.rsplit(".", 1)[0]
+        assert cls_key in scoped, f"{full}: class not in STATE_SCOPE_CLASSES"
+        lifecycle, arg = decl
+        assert lifecycle in state_lifecycle.LIFECYCLES, full
+        if lifecycle in ("bounded", "process_lifetime"):
+            assert arg and str(arg).strip(), (
+                f"{full}: {lifecycle} requires a cap name/justification"
+            )
+    assert registry.LINT_TIME_BUDGET_S > 0

@@ -469,3 +469,106 @@ def test_null_recorder_hook_cost_is_negligible():
         NULL_RECORDER.emit("x", epoch=1)
     dt = time.perf_counter() - t0
     assert dt < 0.5, dt
+
+
+# -- hbstate: the runtime state census (round 16) ----------------------------
+
+
+@pytest.mark.hbstate
+def test_census_take_folds_and_gauges(monkeypatch):
+    """take() snapshots declared containers by class name, sample()
+    folds with max across objects and emits state_census_* gauges."""
+    from hydrabadger_tpu.obs import census
+
+    class FakeCore:
+        def __init__(self, n):
+            self.ledger = list(range(n))
+            self.undeclared = [1, 2, 3]
+
+    monkeypatch.setattr(
+        census, "_TABLE", {"FakeCore": {"ledger": ("per_era", None)}}
+    )
+    assert census.take(FakeCore(4)) == {"FakeCore.ledger": 4}
+    assert census.take(object()) == {}  # unknown classes are silent
+
+    metrics = MetricsRegistry()
+    sc = census.StateCensus(metrics=metrics)
+    folded = sc.sample([FakeCore(2), FakeCore(7)], label=0)
+    assert folded == {"FakeCore.ledger": 7}  # worst node wins
+    snap = metrics.snapshot()
+    assert snap["gauges"]["state_census_FakeCore.ledger"]["value"] == 7
+    assert sc.latest() == {"FakeCore.ledger": 7}
+
+
+@pytest.mark.hbstate
+def test_census_flatness_scoped_lifecycles_only(monkeypatch):
+    """flatness_violations flags per_epoch/per_era growth beyond both
+    slacks; bounded and process_lifetime keys are exempt, and jitter
+    within the slack never trips."""
+    from hydrabadger_tpu.obs import census
+
+    monkeypatch.setattr(
+        census,
+        "_TABLE",
+        {
+            "Core": {
+                "votes": ("per_era", None),
+                "epochs": ("per_epoch", None),
+                "ring": ("bounded", "4096"),
+                "batches": ("process_lifetime", "archive"),
+            }
+        },
+    )
+    baseline = {
+        "Core.votes": 4, "Core.epochs": 2,
+        "Core.ring": 10, "Core.batches": 10,
+    }
+    later = {
+        "Core.votes": 400,     # real leak: over both slacks
+        "Core.epochs": 10,     # within slack_abs (16): jitter
+        "Core.ring": 4096,     # bounded may fill to its cap
+        "Core.batches": 9000,  # process_lifetime is exempt
+    }
+    assert census.flatness_violations(baseline, later) == [
+        "Core.votes: 4 -> 400"
+    ]
+
+
+@pytest.mark.hbstate
+def test_census_lifecycle_table_mirrors_registry():
+    """The runtime table is the lint registry reshaped: every
+    STATE_LIFECYCLE entry lands under its bare class name, and
+    lifecycle_of round-trips."""
+    from hydrabadger_tpu.lint import registry
+    from hydrabadger_tpu.obs import census
+
+    table = census.lifecycle_table()
+    for full, decl in registry.STATE_LIFECYCLE.items():
+        cls_attr = full.split("::", 1)[1]
+        cls_name, attr = cls_attr.split(".", 1)
+        assert table[cls_name][attr] == decl
+        assert census.lifecycle_of(f"{cls_name}.{attr}") == decl[0]
+
+
+@pytest.mark.hbstate
+def test_census_rides_sim_epochs():
+    """SimNetwork samples the census at every epoch boundary: history
+    rows accumulate and the gauges land in the shared registry."""
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    net = SimNetwork(
+        SimConfig(n_nodes=4, protocol="dhb",
+                  txns_per_node_per_epoch=2, txn_bytes=2, seed=3)
+    )
+    try:
+        m = net.run(2)
+        assert m.agreement_ok
+        assert len(net.census.history) == 2
+        row = net.census.latest()
+        assert any(k.startswith("DynamicHoneyBadger.") for k in row)
+        snap = net.metrics.snapshot()
+        assert any(
+            k.startswith("state_census_") for k in snap["gauges"]
+        ), sorted(snap["gauges"])
+    finally:
+        net.shutdown()

@@ -25,7 +25,13 @@ from typing import Dict, Hashable, Optional, Set, TypeVar
 
 from ..obs.recorder import resolve as _resolve_recorder
 from .threshold_sign import ThresholdSign
-from .types import NetworkInfo, Step, guarded_handler
+from .types import (
+    NetworkInfo,
+    Step,
+    guarded_handler,
+    quorum_exists,
+    quorum_intersect,
+)
 
 N = TypeVar("N", bound=Hashable)
 
@@ -153,10 +159,10 @@ class BinaryAgreement:
         state.received_bval[b].add(sender)
         step = Step()
         count = len(state.received_bval[b])
-        f = self.netinfo.num_faulty
-        if count == f + 1 and b not in state.sent_bval:
+        n, f = self.netinfo.num_nodes, self.netinfo.num_faulty
+        if count == quorum_exists(n, f) and b not in state.sent_bval:
             step.extend(self._send_bval(rnd, b))
-        if count == 2 * f + 1:
+        if count == quorum_intersect(n, f):
             first = not state.bin_values
             state.bin_values.add(b)
             if (first and rnd == self.round and not state.aux_sent
@@ -335,7 +341,10 @@ class BinaryAgreement:
         if sender in self.received_term[b]:
             return Step()
         self.received_term[b].add(sender)
-        f = self.netinfo.num_faulty
-        if len(self.received_term[b]) >= f + 1 and self.decision is None:
+        n, f = self.netinfo.num_nodes, self.netinfo.num_faulty
+        if (
+            len(self.received_term[b]) >= quorum_exists(n, f)
+            and self.decision is None
+        ):
             return self._decide(b)
         return Step()

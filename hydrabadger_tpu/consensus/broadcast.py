@@ -46,7 +46,14 @@ from typing import Dict, Hashable, Optional, Tuple, TypeVar
 from ..crypto.engine import get_engine
 from ..obs.recorder import resolve as _resolve_recorder
 from .merkle import MerkleTree, Proof
-from .types import NetworkInfo, Step, Target, guarded_handler
+from .types import (
+    NetworkInfo,
+    Step,
+    Target,
+    guarded_handler,
+    quorum_exists,
+    quorum_intersect,
+)
 
 N = TypeVar("N", bound=Hashable)
 
@@ -283,7 +290,7 @@ class Broadcast:
         if self._count_echos(root) >= n - f and not self.ready_sent:
             step.extend(self._send_ready(root))
         if (
-            self._count_readys(root) >= 2 * f + 1
+            self._count_readys(root) >= quorum_intersect(n, f)
             and self._count_echos(root) >= self.data_shards
         ):
             step.extend(self._try_decode(root))
@@ -303,11 +310,11 @@ class Broadcast:
             return Step()
         self.readys[sender] = root
         step = Step()
-        f = self.netinfo.num_faulty
-        if self._count_readys(root) >= f + 1 and not self.ready_sent:
+        n, f = self.netinfo.num_nodes, self.netinfo.num_faulty
+        if self._count_readys(root) >= quorum_exists(n, f) and not self.ready_sent:
             step.extend(self._send_ready(root))
         if (
-            self._count_readys(root) >= 2 * f + 1
+            self._count_readys(root) >= quorum_intersect(n, f)
             and self._count_echos(root) >= self.data_shards
         ):
             step.extend(self._try_decode(root))
@@ -452,7 +459,7 @@ class Broadcast:
         if self._count_echos_lc(commitment) >= n - f and not self.ready_sent:
             step.extend(self._send_ready_lc(commitment))
         if (
-            self._count_readys(commitment) >= 2 * f + 1
+            self._count_readys(commitment) >= quorum_intersect(n, f)
             and self._count_echos_lc(commitment) >= self.data_shards
         ):
             step.extend(self._try_decode_lc(commitment))
@@ -474,11 +481,14 @@ class Broadcast:
             return Step()
         self.readys[sender] = commitment
         step = Step()
-        f = self.netinfo.num_faulty
-        if self._count_readys(commitment) >= f + 1 and not self.ready_sent:
+        n, f = self.netinfo.num_nodes, self.netinfo.num_faulty
+        if (
+            self._count_readys(commitment) >= quorum_exists(n, f)
+            and not self.ready_sent
+        ):
             step.extend(self._send_ready_lc(commitment))
         if (
-            self._count_readys(commitment) >= 2 * f + 1
+            self._count_readys(commitment) >= quorum_intersect(n, f)
             and self._count_echos_lc(commitment) >= self.data_shards
         ):
             step.extend(self._try_decode_lc(commitment))

@@ -44,7 +44,7 @@ from ..crypto.threshold import PublicKey, PublicKeySet, SecretKey
 from ..obs.recorder import resolve as _resolve_recorder
 from ..utils import codec
 from .honey_badger import Batch, HoneyBadger
-from .types import NetworkInfo, Step, guarded_handler
+from .types import NetworkInfo, Step, dkg_degree, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -723,7 +723,7 @@ class DynamicHoneyBadger:
         """
         state = self.key_gen
         t = (len(state.new_ids) - 1) // 3
-        return state.key_gen.count_complete() > t
+        return state.key_gen.count_complete() >= dkg_degree(t)
 
     def _winning_change(self) -> Optional[tuple]:
         counts: Dict[tuple, int] = {}

@@ -12,7 +12,7 @@ from typing import Dict, Hashable, Optional, TypeVar
 from ..crypto.engine import get_engine
 from ..crypto.threshold import Ciphertext, DecryptionShare
 from ..obs.recorder import resolve as _resolve_recorder
-from .types import NetworkInfo, Step, guarded_handler
+from .types import NetworkInfo, Step, dkg_degree, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -118,7 +118,7 @@ class ThresholdDecrypt:
 
     def _try_decrypt(self) -> Step:
         t = self.netinfo.pk_set.threshold
-        if self.terminated or len(self.shares) <= t:
+        if self.terminated or len(self.shares) < dkg_degree(t):
             return Step()
         step = Step()
         if self.verify_shares:
@@ -142,7 +142,7 @@ class ThresholdDecrypt:
                     else:
                         del self.shares[nid]
                         step.fault(nid, "threshold_decrypt: invalid share")
-            if len(self.shares) <= t:
+            if len(self.shares) < dkg_degree(t):
                 return step
         plaintext = self.engine.combine_decryption_shares(
             self.netinfo.pk_set,

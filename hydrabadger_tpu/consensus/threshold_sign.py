@@ -13,7 +13,7 @@ from typing import Dict, Hashable, Optional, TypeVar
 
 from ..crypto.engine import get_engine
 from ..crypto.threshold import Signature, SignatureShare
-from .types import NetworkInfo, Step, guarded_handler
+from .types import NetworkInfo, Step, dkg_degree, guarded_handler
 
 N = TypeVar("N", bound=Hashable)
 
@@ -75,7 +75,7 @@ class ThresholdSign:
 
     def _try_combine(self) -> Step:
         t = self.netinfo.pk_set.threshold
-        if self.terminated or len(self.shares) <= t:
+        if self.terminated or len(self.shares) < dkg_degree(t):
             return Step()
         step = Step()
         if self.verify_shares:
@@ -95,7 +95,7 @@ class ThresholdSign:
                     else:
                         del self.shares[nid]
                         step.fault(nid, "threshold_sign: invalid share")
-            if len(self.shares) <= t:
+            if len(self.shares) < dkg_degree(t):
                 return step
         sig = self.engine.combine_signature_shares(
             self.netinfo.pk_set,
@@ -115,7 +115,7 @@ class ThresholdSign:
                 else:
                     del self.shares[nid]
                     step.fault(nid, "threshold_sign: invalid share")
-            if len(good) <= t:
+            if len(good) < dkg_degree(t):
                 # not enough verified shares left: stay live and wait
                 # for more instead of terminating on a bogus combine
                 return step

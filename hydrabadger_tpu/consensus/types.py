@@ -149,6 +149,29 @@ class Step(Generic[N]):
         return cls()
 
 
+def quorum_exists(n: int, f: int) -> int:
+    """Existence quorum: among any ``f + 1`` distinct senders at least
+    one is honest.  ``n`` is accepted for call-site symmetry with
+    :func:`quorum_intersect`; under ``n = 3f + 1`` the bound is
+    independent of it."""
+    return f + 1
+
+
+def quorum_intersect(n: int, f: int) -> int:
+    """Intersection quorum: any two sets of ``2f + 1`` distinct senders
+    share at least one honest node (``n = 3f + 1``; the ``n - f``
+    rendering of the same class stays inline where the wait-for-all-
+    correct reading is the point)."""
+    return 2 * f + 1
+
+
+def dkg_degree(t: int) -> int:
+    """Interpolation threshold: ``t + 1`` shares determine a degree-t
+    polynomial — the combine gate of threshold signing/decryption and
+    the committed-DKG readiness gate."""
+    return t + 1
+
+
 def guarded_handler(protocol: str):
     """Decorator for `handle_message(self, sender, message)`: a malformed
     message from a Byzantine peer must yield a fault entry, never an

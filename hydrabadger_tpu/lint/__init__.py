@@ -73,6 +73,27 @@ The hbstate pass (round 16) closes the era-lifecycle gap:
     (``lint/state_lifecycle.py``).  The runtime twin is
     ``obs/census.py``'s per-epoch state census.
 
+The hbquorum passes (round 17) pin the Byzantine arithmetic and the
+observability contracts themselves:
+
+  * **quorum-arith** — every comparison of a count against a
+    fault-tolerance parameter expression (``f + 1``, ``2*f + 1``,
+    ``n - f``, ``t + 1``, the ``> f`` cutover marker) in
+    ``consensus/``/``net/``/``sim/`` is declared in
+    ``registry.QUORUM_SITES`` with a quorum class (existence /
+    intersection / dkg_degree / marker / custom), and the analyzer
+    verifies the class against the actual arithmetic and comparison
+    direction — symbolically, then reduced under ``n = 3f + 1`` /
+    ``t = f`` (``lint/quorum.py``);
+  * **contract-drift** — the tier observability registries
+    (``FAULT_OBSERVABLES`` → ``WIRE_`` → ``PROC_``) are re-evaluated
+    statically: every declared fault substring must match a reachable
+    fault-emit string under scenario.py's exclusive-attribution rules,
+    every minted metric name must be declared in ``obs/metrics.py``
+    (and vice versa), and every ``BYZ_*`` taxonomy kind must have an
+    injection site and a non-empty observable in each tier claiming it
+    (``lint/contract_drift.py``).
+
 Everything the passes treat as special is declared in
 ``lint/registry.py`` — the auditable contract surface.
 
@@ -178,9 +199,10 @@ def _suppressions(sf: SourceFile) -> Tuple[Dict[int, Dict[str, str]], List[Findi
 def all_rules():
     """The rule registry, in report order."""
     from . import async_fetch, await_interference, blocking_async
-    from . import clock_domain, deadcode, env_flags, jit_hygiene
-    from . import limb_layout, mosaic, retrace_budget, sansio, secrets
-    from . import state_lifecycle, taint, task_retention, wire_contract
+    from . import clock_domain, contract_drift, deadcode, env_flags
+    from . import jit_hygiene, limb_layout, mosaic, quorum
+    from . import retrace_budget, sansio, secrets, state_lifecycle
+    from . import taint, task_retention, wire_contract
 
     return [
         sansio,
@@ -198,6 +220,8 @@ def all_rules():
         clock_domain,
         task_retention,
         state_lifecycle,
+        quorum,
+        contract_drift,
         deadcode,
     ]
 

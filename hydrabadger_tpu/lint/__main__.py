@@ -96,7 +96,8 @@ def main(argv=None) -> int:
         description="repo-native static analysis: the per-file contract "
         "rules (sans-io, Mosaic, jit-hygiene, limb-layout, "
         "wire-exhaustiveness, dead-code) plus the interprocedural "
-        "dataflow passes (attacker-taint, secret-taint, retrace-budget)",
+        "dataflow passes (attacker-taint, secret-taint, retrace-budget, "
+        "hbrace, state-lifecycle, quorum-arith, contract-drift)",
     )
     parser.add_argument(
         "files",

@@ -746,3 +746,248 @@ STATE_LIFECYCLE = {
         "bounded", "RX_KIND_CAP len() guard"
     ),
 }
+
+# --------------------------------------------------------------------------
+# quorum arithmetic (lint/quorum.py — hbquorum)
+# --------------------------------------------------------------------------
+
+# Every comparison of a count against a fault-tolerance-parameter
+# expression in consensus/, net/ and sim/ must be declared here.
+#
+#   "relpath::Qualname::<canonical satisfied-at bound>" -> (class, note)
+#
+# The key's bound is the count at which the comparison is SATISFIED,
+# rendered canonically ("f+1", "2*f+1", "n-f", "t+1", "n-2*f", "n*n",
+# ...); one key covers every same-bound comparison inside that function.
+# Classes:
+#
+#   "existence"    f+1-class  — at least one honest witness among any
+#                               f+1 distinct senders;
+#   "intersection" 2f+1/n-f-class — any two such quorums intersect in
+#                               an honest node;
+#   "dkg_degree"   t+1-class  — t+1 shares determine a degree-t
+#                               polynomial;
+#   "marker"       the >f era-cutover marker quorum (arithmetically an
+#                               existence bound, semantically a distinct
+#                               protocol gate);
+#   "custom"       deliberately non-canonical arithmetic — the note is a
+#                               MANDATORY justification.
+#
+# For the canonical classes the note is optional documentation; the
+# analyzer verifies the declared class against the actual arithmetic
+# and comparison direction (symbolically, then reduced under n = 3f+1,
+# t = f).  Stale keys are findings.
+QUORUM_SITES = {
+    # -- consensus/binary_agreement.py -------------------------------------
+    "consensus/binary_agreement.py::BinaryAgreement._handle_bval::f+1": (
+        "existence", "seen a bval an honest node sent: relay it"
+    ),
+    "consensus/binary_agreement.py::BinaryAgreement._handle_bval::2*f+1": (
+        "intersection", "bin_values admission (Mostefaoui BV-broadcast)"
+    ),
+    "consensus/binary_agreement.py::BinaryAgreement._check_aux::n-f": (
+        "intersection",
+        "n-f rendering: wait for all correct nodes' Aux votes",
+    ),
+    "consensus/binary_agreement.py::BinaryAgreement._check_conf::n-f": (
+        "intersection",
+        "n-f rendering: wait for all correct nodes' Conf votes",
+    ),
+    "consensus/binary_agreement.py::BinaryAgreement._handle_term::f+1": (
+        "existence", "f+1 Term carries at least one honest decision"
+    ),
+    # -- consensus/broadcast.py --------------------------------------------
+    "consensus/broadcast.py::Broadcast._handle_echo::n-f": (
+        "intersection", "n-f rendering: Ready once all correct echoed"
+    ),
+    "consensus/broadcast.py::Broadcast._handle_echo::2*f+1": (
+        "intersection", None
+    ),
+    "consensus/broadcast.py::Broadcast._handle_echo::n-2*f": (
+        "existence",
+        "k = data_shards = n - 2f erasure shards; reduces to f+1",
+    ),
+    "consensus/broadcast.py::Broadcast._handle_ready::f+1": (
+        "existence", "Ready amplification (Bracha)"
+    ),
+    "consensus/broadcast.py::Broadcast._handle_ready::2*f+1": (
+        "intersection", None
+    ),
+    "consensus/broadcast.py::Broadcast._handle_ready::n-2*f": (
+        "existence",
+        "k = data_shards = n - 2f erasure shards; reduces to f+1",
+    ),
+    "consensus/broadcast.py::Broadcast._handle_echo_lc::n-f": (
+        "intersection", "n-f rendering: Ready once all correct echoed"
+    ),
+    "consensus/broadcast.py::Broadcast._handle_echo_lc::2*f+1": (
+        "intersection", None
+    ),
+    "consensus/broadcast.py::Broadcast._handle_echo_lc::n-2*f": (
+        "existence",
+        "k = data_shards = n - 2f erasure shards; reduces to f+1",
+    ),
+    "consensus/broadcast.py::Broadcast._handle_ready_lc::f+1": (
+        "existence", "Ready amplification (Bracha)"
+    ),
+    "consensus/broadcast.py::Broadcast._handle_ready_lc::2*f+1": (
+        "intersection", None
+    ),
+    "consensus/broadcast.py::Broadcast._handle_ready_lc::n-2*f": (
+        "existence",
+        "k = data_shards = n - 2f erasure shards; reduces to f+1",
+    ),
+    "consensus/broadcast.py::Broadcast._try_decode_lc::n-2*f": (
+        "existence",
+        "k = data_shards candidates needed before erasure decode",
+    ),
+    # -- consensus/dynamic_honey_badger.py ---------------------------------
+    "consensus/dynamic_honey_badger.py::DynamicHoneyBadger._on_batch::"
+    "n*n+2*n+1": (
+        "custom",
+        "keygen flood cap n(n+2): own Part plus one ack per peer per "
+        "batch with retransmits bounds every legitimate backlog",
+    ),
+    "consensus/dynamic_honey_badger.py::DynamicHoneyBadger._keygen_ready::"
+    "f+1": (
+        "dkg_degree",
+        "t+1 complete proposals, t derived from the NEW era's roster "
+        "((len(new_ids)-1)//3), so the bound renders in f-space",
+    ),
+    "consensus/dynamic_honey_badger.py::DynamicHoneyBadger."
+    "_winning_change::2*count>n": (
+        "custom",
+        "strict majority of distinct committed votes picks the winning "
+        "change; majority (not 2f+1) is the hbbft vote rule",
+    ),
+    "consensus/dynamic_honey_badger.py::DynamicHoneyBadger."
+    "_cutover_committed::f+1": (
+        "marker",
+        ">f committed cutover markers: at least one from an honest "
+        "node that truly finished its shadow settlement",
+    ),
+    "consensus/dynamic_honey_badger.py::_RemovedTracker.handle_part::n": (
+        "custom", "structural arity: one encrypted row per member"
+    ),
+    "consensus/dynamic_honey_badger.py::_RemovedTracker.handle_ack::n": (
+        "custom", "structural arity: one encrypted value per member"
+    ),
+    "consensus/dynamic_honey_badger.py::_RemovedTracker._complete::2*t+1": (
+        "intersection",
+        "2t+1 structural acks — the same objective gate as "
+        "_ProposalState.is_complete, so leaver and validators agree",
+    ),
+    # -- consensus/subset.py -----------------------------------------------
+    "consensus/subset.py::Subset._global_transitions::n-f": (
+        "intersection",
+        "n-f rendering: n-f accepted slots before voting 0 elsewhere",
+    ),
+    "consensus/subset.py::Subset._global_transitions::n": (
+        "custom", "completion needs ALL n ABA instances decided"
+    ),
+    # -- consensus/threshold_*.py ------------------------------------------
+    "consensus/threshold_decrypt.py::ThresholdDecrypt._try_decrypt::t+1": (
+        "dkg_degree", None
+    ),
+    "consensus/threshold_sign.py::ThresholdSign._try_combine::t+1": (
+        "dkg_degree", None
+    ),
+    # -- net/node.py -------------------------------------------------------
+    "net/node.py::KeyGenMachine.handle_ack::n*n": (
+        "custom",
+        "pending-ack dedup ceiling: n senders x n proposer slots is "
+        "the whole key space; reaching it means the invariant broke",
+    ),
+    "net/node.py::KeyGenMachine.is_complete::n": (
+        "custom",
+        "bootstrap all-n gate (key_gen.rs:373-386): every member's "
+        "proposal complete before generate",
+    ),
+    "net/node.py::KeyGenMachine.is_complete::n*n": (
+        "custom",
+        "bootstrap n^2 ack gate (key_gen.rs:373-386): every member "
+        "acked every proposal",
+    ),
+    "net/node.py::Hydrabadger._certified_frontier::f+1": (
+        "existence",
+        "f+1 signed frontier claims agree: at least one honest, so "
+        "the (f+1)-th largest epoch is honestly certified",
+    ),
+    "net/node.py::Hydrabadger._on_era_transcript::2*n*n+2*n+1": (
+        "custom",
+        "transcript replay cap 2(n + n^2): n Parts + n^2 acks + "
+        "batch-boundary markers bounded by traffic-bearing batches",
+    ),
+}
+
+# --------------------------------------------------------------------------
+# contract drift (lint/contract_drift.py — hbquorum)
+# --------------------------------------------------------------------------
+
+# The fault-observability registries, innermost tier first.  Each entry
+# is (relpath, module-level dict name); later tiers may copy earlier
+# ones (dict(BASE) + .update / subscript-assign), and the analyzer
+# re-evaluates that construction statically.
+CONTRACT_TIERS = (
+    ("sim/scenario.py", "FAULT_OBSERVABLES"),
+    ("net/chaos.py", "WIRE_FAULT_OBSERVABLES"),
+    ("net/cluster.py", "PROC_FAULT_OBSERVABLES"),
+)
+
+# Where metric names are declared and where the BYZ_* taxonomy lives
+# (fixture packages repoint these via monkeypatch).
+CONTRACT_METRICS_MODULE = "obs/metrics.py"
+CONTRACT_TAXONOMY_MODULE = "consensus/types.py"
+
+# Exclusive-attribution escape hatch: fault-emit strings that two
+# registry families deliberately share at equal match length, with the
+# justification mirrored from sim/scenario.py's runtime attribution
+# rules.  substring -> (sorted kinds tuple, why).
+CONTRACT_SHARED_SUBSTRINGS = {
+    "threshold_decrypt: conflicting share": (
+        ("garbage_share", "replay_flood"),
+        "a replayed decryption share and an attacker-minted conflicting "
+        "share are the SAME wire evidence (two different shares under "
+        "one (sender, proposer) key); scenario._attribute resolves the "
+        "tie toward the kind the run actually injected, which is "
+        "exactly the intent",
+    ),
+}
+
+# Metric-minting wrapper functions: a call to one of these mints the
+# counter/gauge named by the given argument, and the wrapper's own
+# internal dynamic ``.counter(name)`` call is exempt.
+#   "relpath::Class.method" -> (positional index, keyword name)
+METRIC_MINT_WRAPPERS = {
+    # fault-ring entry + optional detection counter in one call
+    "net/node.py::Hydrabadger._note_fault": (1, "counter"),
+    # checkpoint store bookkeeping (store is metrics-optional)
+    "checkpoint.py::CheckpointStore._count": (0, "name"),
+    # held-frame delivery whose failure mints the loss counter
+    "net/chaos.py::ChaosWireStream._send_after": (2, "lost_kind"),
+}
+
+# Call sites that mint metric names dynamically (folding snapshots,
+# prefix families, injection bookkeeping).  Keyed by the enclosing
+# function; the value lists the names/prefixes the site can mint (for
+# the declared-but-never-minted check) plus a mandatory justification.
+#   "relpath::Qualname" -> (names tuple | None, why)
+METRIC_DYNAMIC_MINTS = {
+    "sim/scenario.py::verify_observability": (
+        None,
+        "reads the DECLARED observables back out of the registry "
+        "(counter/gauge get-or-create on names that came from "
+        "FAULT_OBSERVABLES entries this pass already checks)",
+    ),
+    "net/chaos.py::merge_node_metrics": (
+        None,
+        "folds per-node registry snapshots into one; every name it "
+        "re-mints was minted (and therefore checked) at its original "
+        "site",
+    ),
+    "net/cluster.py::ClusterSupervisor.merged_metrics": (
+        None,
+        "folds child-process summary lines into one registry; every "
+        "name originated in a child's own checked mint site",
+    ),
+}

@@ -31,7 +31,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..consensus.dynamic_honey_badger import DhbBatch, DynamicHoneyBadger, JoinPlan
-from ..consensus.types import NetworkInfo, Step
+from ..consensus.types import NetworkInfo, Step, quorum_exists
 from ..crypto.dkg import Ack, Part, SyncKeyGen
 from ..crypto.engine import get_engine
 from ..crypto.threshold import PublicKey, SecretKey, Signature
@@ -1401,7 +1401,7 @@ class Hydrabadger:
             groups.setdefault(claim[2], []).append(claim)
         best = None
         for members in groups.values():
-            if len(members) < f + 1:
+            if len(members) < quorum_exists(n, f):
                 continue
             members = sorted(
                 members, key=lambda c: (c[0], c[1]), reverse=True

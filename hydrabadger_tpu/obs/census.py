@@ -19,9 +19,9 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from .metrics import MetricsRegistry, default_registry
+from .metrics import STATE_CENSUS_PREFIX, MetricsRegistry, default_registry
 
-GAUGE_PREFIX = "state_census_"
+GAUGE_PREFIX = STATE_CENSUS_PREFIX
 
 # census history depth (epochs); enough for any soak window
 HISTORY_CAP = 4096

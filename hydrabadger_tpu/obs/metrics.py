@@ -153,6 +153,123 @@ WIRE_RETRY_ABANDONED = "wire_retry_abandoned"
 NODE_FAST_FORWARDS = "node_fast_forwards"
 BYZ_DUP_SUPPRESSED = "byz_dup_suppressed"
 
+# Per-kind received-frame counters: the prefix is suffixed by a
+# ``net/wire.py:KINDS`` member, so the family is bounded by the fixed
+# wire vocabulary (same stance as BYTES_RX_BY_KIND_PREFIX).
+WIRE_RX_PREFIX = "wire_rx_"
+
+# Epoch/commit plane (net/node.py commit path):
+#
+#   EPOCHS_COMMITTED — committed epochs, the denominator every per-epoch
+#       rate (bytes, duration, faults) divides by.
+#   EPOCH_DURATION_S — histogram of wall seconds per committed epoch.
+#   CONSENSUS_FAULTS — fault_log entries the cores reported (the raw
+#       feed the byz_faults_* attribution folds from).
+EPOCHS_COMMITTED = "epochs_committed"
+EPOCH_DURATION_S = "epoch_duration_s"
+CONSENSUS_FAULTS = "consensus_faults"
+
+# Crash/partition healing plane (net/node.py recovery paths).  The
+# wire-tier observability contract (net/chaos.py) reads several of
+# these, so the spellings are load-bearing:
+#
+#   WELCOME_BACK_REPLAYS — a reconnecting peer was served the in-flight
+#       epoch's traffic again (barely-behind recovery).
+#   OBSERVER_ADOPTIONS — a voted-out-and-readded node recovered through
+#       observer adoption.
+#   EPOCH_REPLAYS — epoch outbox replays served to lagging peers (the
+#       partition/link-loss healing observable).
+#   EPOCH_REPLAYS_SUPPRESSED — replay requests absorbed by the
+#       per-peer replay budget.
+#   WIRE_RETRY_DROPPED — frames dropped when the retry ring was full
+#       (bounded loss under sustained peer absence).
+#   HANDSHAKE_TIMEOUTS — inbound connections that never completed the
+#       hello exchange.
+WELCOME_BACK_REPLAYS = "welcome_back_replays"
+OBSERVER_ADOPTIONS = "observer_adoptions"
+EPOCH_REPLAYS = "epoch_replays"
+EPOCH_REPLAYS_SUPPRESSED = "epoch_replays_suppressed"
+WIRE_RETRY_DROPPED = "wire_retry_dropped"
+HANDSHAKE_TIMEOUTS = "handshake_timeouts"
+
+# Bounded-queue inventory (PR-3): every bounded queue exports its depth
+# as a gauge (current, high-water) and its shed events as a counter.
+# One spelling per queue, sampled by net/node.py's per-epoch census and
+# the sim router.
+INTERNAL_QUEUE_DEPTH = "internal_queue_depth"
+INTERNAL_QUEUE_OVERFLOWS = "internal_queue_overflows"
+WIRE_RETRY_DEPTH = "wire_retry_depth"
+EPOCH_OUTBOX_DEPTH = "epoch_outbox_depth"
+KEYGEN_OUTBOX_DEPTH = "keygen_outbox_depth"
+KEYGEN_INBOX_DEPTH = "keygen_inbox_depth"
+IOM_QUEUE_DEPTH = "iom_queue_depth"
+PENDING_USER_DEPTH = "pending_user_depth"
+PENDING_ACKS_DEPTH = "pending_acks_depth"
+PEER_SEND_QUEUE_DEPTH = "peer_send_queue_depth"
+PEER_SEND_QUEUE_OVERFLOWS = "peer_send_queue_overflows"
+ROUTER_QUEUE_DEPTH = "router_queue_depth"
+
+# Transport/bridge bookkeeping:
+#
+#   WIRE_TX_FRAMES — frames handed to peer send queues.
+#   BRIDGE_* — the TPU bridge's batch dispatch plane.
+#   CHAOS_PARTITION_LOST / CHAOS_DELAY_LOST — a chaos-held frame whose
+#       connection died before release: at the wire tier a hold CAN
+#       become a loss, and the counter keeps it observable.
+WIRE_TX_FRAMES = "wire_tx_frames"
+BRIDGE_BATCHES_DISPATCHED = "bridge_batches_dispatched"
+BRIDGE_REQUESTS_SERVED = "bridge_requests_served"
+CHAOS_PARTITION_LOST = "chaos_partition_lost"
+CHAOS_DELAY_LOST = "chaos_delay_lost"
+
+# Process-tier supervisor (net/cluster.py): child lifecycle counts the
+# crash-restart SOAK rows assert on.
+PROC_SPAWNS = "proc_spawns"
+PROC_SIGKILLS = "proc_sigkills"
+PROC_SIGTERMS = "proc_sigterms"
+PROC_RESTARTS = "proc_restarts"
+PROC_UNEXPECTED_EXITS = "proc_unexpected_exits"
+
+# Sim router adversary chokepoint: what the adversary absorbed/emitted
+# (rewrites are counted at the single enqueue seam).
+ROUTER_ADV_ABSORBED = "router_adv_absorbed"
+ROUTER_ADV_EMITTED = "router_adv_emitted"
+
+# hbasync futures plane (crypto/futures.py): submit/fetch volume plus
+# the MSM coalescing window's shape.
+CRYPTO_FUTURES_SUBMITTED = "crypto_futures_submitted"
+CRYPTO_FUTURES_FETCHED = "crypto_futures_fetched"
+CRYPTO_FUTURES_DROPPED = "crypto_futures_dropped"
+MSM_COALESCE_SUBMISSIONS = "msm_coalesce_submissions"
+MSM_COALESCE_FLUSHES = "msm_coalesce_flushes"
+MSM_COALESCE_WIDTH = "msm_coalesce_width"
+
+# Kernel lane-occupancy counters (ops/): real vs padded lanes per
+# batched TPU dispatch — the padding-waste figure the bench rows and
+# the soak lane-occupancy row read.
+HOMHASH_REAL_LANES = "homhash_real_lanes"
+HOMHASH_PAD_LANES = "homhash_pad_lanes"
+HOMHASH_LANE_OCCUPANCY = "homhash_lane_occupancy"
+NTT_BATCH_LANES = "ntt_batch_lanes"
+NTT_PAD_LANES = "ntt_pad_lanes"
+NTT_REAL_LANES = "ntt_real_lanes"
+FR_NTT_BATCH_LANES = "fr_ntt_batch_lanes"
+FR_NTT_PAD_LANES = "fr_ntt_pad_lanes"
+FR_NTT_REAL_LANES = "fr_ntt_real_lanes"
+MUL_BATCH_LANES = "mul_batch_lanes"
+MUL_BATCH_PAD_LANES = "mul_batch_pad_lanes"
+MUL_BATCH_REAL_LANES = "mul_batch_real_lanes"
+MSM_BATCH_LANES = "msm_batch_lanes"
+MSM_PAD_LANES = "msm_pad_lanes"
+MSM_REAL_LANES = "msm_real_lanes"
+
+# Observability planes that mint per-key families from fixed keyspaces:
+# the per-epoch state census (obs/census.py, keyed by registered
+# lifecycle attrs) and the retrace tripwire (obs/retrace.py, keyed by
+# jit entrypoint names).
+STATE_CENSUS_PREFIX = "state_census_"
+RETRACE_SIGS_PREFIX = "retrace_sigs_"
+
 
 class Counter:
     __slots__ = ("value",)

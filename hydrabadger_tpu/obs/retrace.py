@@ -33,7 +33,7 @@ import ast
 from pathlib import Path
 from typing import Dict, List, Set, Tuple
 
-from .metrics import default_registry
+from .metrics import RETRACE_SIGS_PREFIX, default_registry
 
 _PACKAGE_ROOT = Path(__file__).resolve().parents[1]
 
@@ -45,7 +45,7 @@ def note(entry: str, *dims) -> None:
     """Record one dispatch of ``entry`` with shape signature ``dims``."""
     sigs = _signatures.setdefault(entry, set())
     sigs.add(tuple(dims))
-    default_registry().gauge(f"retrace_sigs_{entry}").track(len(sigs))
+    default_registry().gauge(RETRACE_SIGS_PREFIX + entry).track(len(sigs))
 
 
 def observed() -> Dict[str, Set[Tuple]]:

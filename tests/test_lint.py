@@ -15,11 +15,13 @@ from hydrabadger_tpu.lint import (
     blocking_async,
     callgraph,
     clock_domain,
+    contract_drift,
     deadcode,
     env_flags,
     jit_hygiene,
     limb_layout,
     mosaic,
+    quorum,
     registry,
     retrace_budget,
     sansio,
@@ -1473,6 +1475,320 @@ def test_state_lifecycle_drain_swap_is_a_reset(tmp_path, monkeypatch):
         lifecycle={"net/node.py::Node.q": ("bounded", "drain-requeue")},
     )
     assert [f.render() for f in state_lifecycle.check(sf)] == []
+
+
+# -- hbquorum: quorum-arithmetic & contract-drift fixtures (round 17) --------
+#
+# Each known-bad package gets its OWN registry tables via monkeypatch so
+# the fixtures exercise exactly one contract each: an undeclared quorum
+# comparison, a wrong-direction existence guard, an existence guard
+# misdeclared as intersection, a stale QUORUM_SITES key, a stale tier
+# fault substring, and a declared-but-never-minted gauge.
+
+
+@pytest.mark.hbquorum
+def test_quorum_undeclared_comparison_fires(tmp_path, monkeypatch):
+    """A count-vs-parameter comparison with no QUORUM_SITES declaration
+    is the base finding; declaring its class silences."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "consensus/bad.py": """\
+                class Core:
+                    def have_quorum(self, shares, f):
+                        return len(shares) >= f + 1
+                """,
+        },
+    )
+    monkeypatch.setattr(registry, "QUORUM_SITES", {})
+    messages = [f.message for f in quorum.check(sf)]
+    assert any("undeclared quorum comparison" in m for m in messages), messages
+    monkeypatch.setattr(
+        registry,
+        "QUORUM_SITES",
+        {"consensus/bad.py::Core.have_quorum::f+1": ("existence", None)},
+    )
+    assert [f.render() for f in quorum.check(sf)] == []
+
+
+@pytest.mark.hbquorum
+def test_quorum_wrong_direction_guard_fires(tmp_path, monkeypatch):
+    """``len(shares) > f + 1`` waits for f+2 shares where the existence
+    bound needs only f+1 — the strictness is in the wrong direction.
+    The canonical ``>= f + 1`` rendering silences under the SAME key
+    class."""
+    bad = """\
+        class Core:
+            def decrypt_ready(self, shares, f):
+                return len(shares) > f + 1
+        """
+    good = """\
+        class Core:
+            def decrypt_ready(self, shares, f):
+                return len(shares) >= f + 1
+        """
+    for name, code, key_bound, expect_finding in (
+        ("bad", bad, "f+2", True), ("good", good, "f+1", False),
+    ):
+        pkg = tmp_path / name
+        pkg.mkdir()
+        sf = make_pkg(pkg, {"consensus/td.py": code})
+        monkeypatch.setattr(
+            registry,
+            "QUORUM_SITES",
+            {
+                f"consensus/td.py::Core.decrypt_ready::{key_bound}": (
+                    "existence", None
+                )
+            },
+        )
+        messages = [f.message for f in quorum.check(sf)]
+        if expect_finding:
+            assert any(
+                "contradicts its declared class" in m
+                and "satisfied at f+2" in m
+                for m in messages
+            ), messages
+        else:
+            assert messages == [], messages
+
+
+@pytest.mark.hbquorum
+def test_quorum_misclassified_existence_vs_intersection(
+    tmp_path, monkeypatch
+):
+    """An f+1 existence guard declared ``intersection`` contradicts the
+    canonical 2f+1 / n-f forms; re-declaring it ``existence`` silences.
+    The n-f rendering is accepted for intersection via the n = 3f+1
+    reduction."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "consensus/ba.py": """\
+                class Agreement:
+                    def relay_ready(self, votes, f):
+                        return len(votes) > f
+
+                    def commit_ready(self, votes, n, f):
+                        return len(votes) >= n - f
+                """,
+        },
+    )
+    sites = {
+        "consensus/ba.py::Agreement.relay_ready::f+1": (
+            "intersection", None
+        ),
+        "consensus/ba.py::Agreement.commit_ready::n-f": (
+            "intersection", None
+        ),
+    }
+    monkeypatch.setattr(registry, "QUORUM_SITES", dict(sites))
+    messages = [f.message for f in quorum.check(sf)]
+    assert any(
+        "contradicts its declared class" in m and "'intersection'" in m
+        for m in messages
+    ), messages
+    assert not any("commit_ready" in m for m in messages), messages
+    sites["consensus/ba.py::Agreement.relay_ready::f+1"] = (
+        "existence", None
+    )
+    monkeypatch.setattr(registry, "QUORUM_SITES", dict(sites))
+    assert [f.render() for f in quorum.check(sf)] == []
+
+
+@pytest.mark.hbquorum
+def test_quorum_stale_site_and_custom_justification(tmp_path, monkeypatch):
+    """Registry rot is a finding (a declared key matching no comparison
+    any more), and a ``custom`` site without a justification is one
+    too."""
+    sf = make_pkg(
+        tmp_path,
+        {
+            "consensus/dkg.py": """\
+                class KeyGen:
+                    def part_ready(self, acks, t):
+                        return len(acks) >= 2 * t + 2
+                """,
+        },
+    )
+    monkeypatch.setattr(
+        registry,
+        "QUORUM_SITES",
+        {
+            "consensus/dkg.py::KeyGen.part_ready::2*t+2": ("custom", ""),
+            "consensus/gone.py::Vanished.check::f+1": ("existence", None),
+        },
+    )
+    messages = [f.render() for f in quorum.check(sf)]
+    assert any(
+        "custom quorum site" in m and "no justification" in m
+        for m in messages
+    ), messages
+    assert any(
+        "stale QUORUM_SITES entry" in m and "Vanished" in m
+        for m in messages
+    ), messages
+    monkeypatch.setattr(
+        registry,
+        "QUORUM_SITES",
+        {
+            "consensus/dkg.py::KeyGen.part_ready::2*t+2": (
+                "custom", "fixture: deliberate extra-slack bound"
+            ),
+        },
+    )
+    assert [f.render() for f in quorum.check(sf)] == []
+
+
+@pytest.mark.hbquorum
+def test_quorum_repo_registry_is_live():
+    """Every QUORUM_SITES key matches a real comparison, every class is
+    known, and every custom site carries a justification — the table
+    cannot silently rot."""
+    assert registry.QUORUM_SITES, "QUORUM_SITES must not be empty"
+    live = {
+        s.key for s in quorum.collect_sites(callgraph.build(PACKAGE_ROOT))
+    }
+    for key, (cls, note) in registry.QUORUM_SITES.items():
+        assert cls in quorum.CLASSES, (key, cls)
+        assert key in live, f"stale QUORUM_SITES key: {key}"
+        if cls == "custom":
+            assert note and str(note).strip(), (
+                f"{key}: custom requires a justification"
+            )
+    # the taxonomy is actually exercised: at least one site per
+    # canonical class is declared in the real tree
+    classes = {cls for cls, _ in registry.QUORUM_SITES.values()}
+    assert {"existence", "intersection", "dkg_degree"} <= classes
+
+
+def _drift_pkg(tmp_path, name, scenario):
+    pkg = tmp_path / name
+    pkg.mkdir()
+    return make_pkg(
+        pkg,
+        {
+            "taxonomy.py": """\
+                BYZ_SILENCE = "silence"
+                """,
+            "metrics.py": """\
+                FAULTS_SEEN = "faults_seen"
+                QUEUE_DEPTH = "queue_depth"
+                """,
+            "scenario.py": scenario,
+        },
+    )
+
+
+def _patch_drift_tables(monkeypatch):
+    monkeypatch.setattr(
+        registry, "CONTRACT_TIERS", (("scenario.py", "FAULT_OBSERVABLES"),)
+    )
+    monkeypatch.setattr(registry, "CONTRACT_METRICS_MODULE", "metrics.py")
+    monkeypatch.setattr(registry, "CONTRACT_TAXONOMY_MODULE", "taxonomy.py")
+    monkeypatch.setattr(registry, "CONTRACT_SHARED_SUBSTRINGS", {})
+    monkeypatch.setattr(registry, "METRIC_MINT_WRAPPERS", {})
+    monkeypatch.setattr(registry, "METRIC_DYNAMIC_MINTS", {})
+
+
+_DRIFT_GREEN = """\
+    from .metrics import FAULTS_SEEN, QUEUE_DEPTH
+    from .taxonomy import BYZ_SILENCE
+
+    class SilenceAttack:
+        kind = BYZ_SILENCE
+
+    def run(recorder, metrics):
+        recorder.fault("node0", "silence: peer went quiet")
+        metrics.counter(FAULTS_SEEN).inc()
+        metrics.gauge(QUEUE_DEPTH).track(0)
+
+    FAULT_OBSERVABLES = {
+        BYZ_SILENCE: ObsSpec(
+            fault_any=("silence: peer went quiet",),
+            counters=(FAULTS_SEEN,),
+        ),
+    }
+    """
+
+
+@pytest.mark.hbquorum
+def test_contract_drift_green_fixture_is_clean(tmp_path, monkeypatch):
+    """The baseline fixture satisfies all three contracts: the tier's
+    substring matches a reachable emit, every minted name is declared
+    (and vice versa), and the taxonomy kind is injected + claimed."""
+    sf = _drift_pkg(tmp_path, "green", _DRIFT_GREEN)
+    _patch_drift_tables(monkeypatch)
+    assert [f.render() for f in contract_drift.check(sf)] == []
+
+
+@pytest.mark.hbquorum
+def test_contract_drift_stale_tier_substring_fires(tmp_path, monkeypatch):
+    """A tier fault substring that no statically reachable emit can
+    produce is exactly the drift the pass exists for — the scenario
+    would silently stop observing its fault."""
+    sf = _drift_pkg(
+        tmp_path,
+        "stale_sub",
+        _DRIFT_GREEN.replace(
+            'fault_any=("silence: peer went quiet",)',
+            'fault_any=("vanished: renamed emit",)',
+        ),
+    )
+    _patch_drift_tables(monkeypatch)
+    messages = [f.message for f in contract_drift.check(sf)]
+    assert any(
+        "declares fault substring 'vanished: renamed emit'" in m
+        for m in messages
+    ), messages
+
+
+@pytest.mark.hbquorum
+def test_contract_drift_unminted_declared_gauge_fires(
+    tmp_path, monkeypatch
+):
+    """A metric declared in the metrics module that no reachable call
+    site mints is dead observability — both the declaration and any
+    tier reference to it fire."""
+    sf = _drift_pkg(
+        tmp_path,
+        "unminted",
+        _DRIFT_GREEN.replace(
+            "        metrics.gauge(QUEUE_DEPTH).track(0)\n", ""
+        ),
+    )
+    _patch_drift_tables(monkeypatch)
+    messages = [f.message for f in contract_drift.check(sf)]
+    assert any(
+        "declared metric QUEUE_DEPTH = 'queue_depth' is never minted" in m
+        for m in messages
+    ), messages
+
+
+@pytest.mark.hbquorum
+def test_contract_drift_undeclared_mint_and_uninjected_kind(
+    tmp_path, monkeypatch
+):
+    """The reverse directions: a counter minted under a name the
+    metrics module never declared, and a taxonomy kind no strategy or
+    ``note`` site ever injects."""
+    sf = _drift_pkg(
+        tmp_path,
+        "reverse",
+        _DRIFT_GREEN.replace(
+            "metrics.counter(FAULTS_SEEN).inc()",
+            'metrics.counter("faults_seen_typo").inc()',
+        ).replace("        kind = BYZ_SILENCE\n", "        pass\n"),
+    )
+    _patch_drift_tables(monkeypatch)
+    messages = [f.message for f in contract_drift.check(sf)]
+    assert any(
+        "'faults_seen_typo' is minted here but not declared" in m
+        for m in messages
+    ), messages
+    assert any(
+        "inject" in m and "'silence'" in m for m in messages
+    ), messages
 
 
 @pytest.mark.hbstate

@@ -1507,6 +1507,103 @@ def _era_age_config16(n_nodes: int = 64, eras: int = 3,
     }
 
 
+def _txn_latency_config17(n_nodes: int = 64, epochs: int = 2) -> dict:
+    """Transaction-latency row (the txn-latency plane's 64-node
+    capture): submit->committed p50/p99 on the full message plane,
+    honest vs under the PR-7 attack catalog, with the plane's own
+    accuracy contract asserted IN the row —
+
+      * the DDSketch percentiles must sit within 2%% relative error of
+        the exact quantiles recomputed from the raw e2e samples the sim
+        also retains (the mergeable storage is only worth shipping if
+        its error model holds on live data, not just unit-test
+        distributions), and
+      * the per-stage attribution (admission + propose-wait +
+        consensus) must sum within 10%% of measured end-to-end — each
+        txn's spans partition its lifetime by construction, so a larger
+        gap means stage notes are being dropped.
+
+    Cheap-crypto tier by design: at 64 nodes the full message plane is
+    the cost driver (a full-crypto chaos epoch runs ~10 min; config 11
+    owns crypto-under-attack at 4/16 nodes), and the latency plane
+    under test is crypto-agnostic."""
+    from hydrabadger_tpu.obs.latency import exact_quantile
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+    from hydrabadger_tpu.sim.scenario import attack_spec
+
+    t_total0 = time.perf_counter()
+
+    def leg(scenario, label):
+        net = SimNetwork(
+            SimConfig(
+                n_nodes=n_nodes, protocol="qhb", encrypt=False,
+                verify_shares=False, txns_per_node_per_epoch=2,
+                txn_bytes=8, seed=31, scenario=scenario,
+            )
+        )
+        m = net.run(epochs)
+        assert m.agreement_ok, f"config17 {label} leg lost agreement"
+        snap = net.txn_latency_snapshot()
+        spans = net.span_sketches()
+        exact = net.exact_e2e_samples()
+        assert snap["count"] > 0 and exact, (
+            f"config17 {label} leg measured no submit->commit latency"
+        )
+        e2e = spans["e2e"]
+        sketch_err = {}
+        for q_label, q in (("p50", 0.5), ("p99", 0.99)):
+            approx, truth = e2e.quantile(q), exact_quantile(exact, q)
+            err = abs(approx - truth) / truth if truth else 0.0
+            assert err <= 0.02, (
+                f"config17 {label}: sketch {q_label} {approx:.4f}s is "
+                f"{err:.1%} off the exact {truth:.4f}s (> 2% budget)"
+            )
+            sketch_err[q_label] = round(err, 5)
+        stage_names = ("admission", "propose_wait", "consensus")
+        stage_sum = sum(spans[s].sum for s in stage_names if s in spans)
+        gap = abs(stage_sum - e2e.sum) / e2e.sum if e2e.sum else 0.0
+        assert gap <= 0.10, (
+            f"config17 {label}: stage spans sum to {stage_sum:.2f}s vs "
+            f"{e2e.sum:.2f}s end-to-end ({gap:.1%} > 10%) — stage "
+            "notes are being dropped"
+        )
+        if scenario is not None:
+            net.verify_scenario()
+        net.shutdown()
+        return dict(
+            snap,
+            stage_mean_s={
+                s: round(spans[s].sum / spans[s].count, 6)
+                for s in stage_names if s in spans and spans[s].count
+            },
+            stage_sum_vs_e2e_gap=round(gap, 5),
+            sketch_vs_exact_err=sketch_err,
+        )
+
+    honest = leg(None, "honest")
+    chaos = leg(attack_spec(n_nodes, seed=31), "chaos")
+    return {
+        "metric": f"txn_latency_p99_s_{n_nodes}node_chaos",
+        "value": chaos["p99"],
+        "unit": (
+            "submit->committed p99 seconds under the attack catalog "
+            "(honest twin alongside; sketch error <= 2% and stage "
+            "decomposition <= 10% gap asserted in-row)"
+        ),
+        "n_nodes": n_nodes,
+        "epochs_per_leg": epochs,
+        "honest": honest,
+        "chaos": chaos,
+        "chaos_vs_honest_p50": (
+            round(chaos["p50"] / honest["p50"], 3)
+            if honest["p50"] else None
+        ),
+        "sketch_rel_err_budget": 0.02,
+        "stage_sum_gap_budget": 0.10,
+        "total_wall_s": round(time.perf_counter() - t_total0, 1),
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1514,7 +1611,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--config",
         type=int,
-        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16],
+        choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17],
         default=6,
         help="BASELINE.json config: 1 = 4-node TCP testnet (full crypto), "
         "2 = 16-node sim CPU, 3 = RS shard throughput on TPU, 4 = batched "
@@ -1540,7 +1637,10 @@ def main(argv=None) -> int:
         "cluster-timeline wire-event stamps' increment must cost <5%%), "
         "16 = era-age row (DHB crosses >= 3 era switches; later-era "
         "steady epoch p50 must stay within 1.2x era 0 and the state "
-        "census must read flat — the config-5 era-age tripwire)",
+        "census must read flat — the config-5 era-age tripwire), "
+        "17 = txn-latency row (64-node submit->committed p50/p99, "
+        "honest vs the attack catalog, with sketch-vs-exact <= 2%% and "
+        "per-stage attribution summing within 10%% asserted in-row)",
     )
     p.add_argument(
         "--rbc",
@@ -1667,6 +1767,11 @@ def main(argv=None) -> int:
              lambda: _era_age_config16(args.nodes, eras=3,
                                        steady_epochs=epochs_or(3)),
              "tpu"),
+            # txn-latency plane: pure host sim either way (the message
+            # plane is the cost driver; crypto deliberately cheap)
+            ("config17_txn_latency",
+             lambda: _txn_latency_config17(args.nodes, epochs_or(2)),
+             "always"),
         ]
         jax_ok = not probe.get("error")
         backend_lost = False
@@ -1812,6 +1917,10 @@ def main(argv=None) -> int:
             lambda: _era_age_config16(
                 args.nodes, eras=3, steady_epochs=epochs_or(3)
             )
+        )
+    if args.config == 17:
+        return single(
+            lambda: _txn_latency_config17(args.nodes, epochs_or(2))
         )
 
     # config 3 (also the fall-through for the bare invocation)

@@ -354,6 +354,10 @@ def main(argv=None) -> int:
                 k: g["high_water"] for k, g in snap["gauges"].items()
             },
             "faults": [f.kind for _nid, f in node.fault_log],
+            # per-span latency sketches: mergeable across nodes AND
+            # across this node's SIGKILL'd incarnations — the supervisor
+            # folds the LAST feed of every pid, scaled by drift rate
+            "sketches": node.txn_lifecycle.sketch_feed(),
         }
 
     def append_summary(final: bool = False) -> None:

@@ -11,6 +11,9 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Hashable, List, TypeVar
 
+from ..obs.latency import (
+    STAGE_ADMITTED, STAGE_COMMITTED, STAGE_PROPOSED, txn_id,
+)
 from ..utils import codec
 from .honey_badger import Batch, HoneyBadger
 from .types import NetworkInfo, Step
@@ -32,11 +35,16 @@ class QueueingHoneyBadger:
         engine=None,
         recorder=None,
         rbc_variant=None,
+        lifecycle=None,
     ):
         self.netinfo = netinfo
         self.batch_size = max(1, batch_size)
         self.rng = rng
         self.auto_propose = auto_propose
+        # sans-io txn-lifecycle ledger (obs/latency.py): the core NOTES
+        # identity-tagged inclusion events with no timestamps; the I/O
+        # boundary stamps them — the recorder contract, per-transaction
+        self.lifecycle = lifecycle
         self.queue: "OrderedDict[bytes, None]" = OrderedDict()
         self.hb = HoneyBadger(
             netinfo,
@@ -55,6 +63,8 @@ class QueueingHoneyBadger:
     def push_transaction(self, txn: bytes, rng=None) -> Step:
         """Queue a transaction; kicks off an epoch if none is in flight."""
         self.queue[bytes(txn)] = None
+        if self.lifecycle is not None:
+            self.lifecycle.note_stage(txn_id(txn), STAGE_ADMITTED)
         rng = rng or self.rng
         if rng is not None:
             return self._maybe_propose(rng)
@@ -86,9 +96,14 @@ class QueueingHoneyBadger:
             : self.batch_size * max(1, self.netinfo.num_nodes)
         ]
         per_node = max(1, self.batch_size // max(1, self.netinfo.num_nodes))
-        if len(window) <= per_node:
-            return window
-        return rng.sample(window, per_node)
+        picked = (
+            window if len(window) <= per_node
+            else rng.sample(window, per_node)
+        )
+        if self.lifecycle is not None:
+            for t in picked:
+                self.lifecycle.note_stage(txn_id(t), STAGE_PROPOSED)
+        return picked
 
     def _propose(self, rng) -> Step:
         contribution = codec.encode(tuple(self._sample(rng)))
@@ -114,6 +129,11 @@ class QueueingHoneyBadger:
                 contributions[proposer] = txns
                 for t in txns:
                     self.queue.pop(t, None)
+                    # committed-batch membership, for EVERY txn in the
+                    # batch: only the submitting node holds the open
+                    # record, foreign ids resolve to nothing
+                    if self.lifecycle is not None:
+                        self.lifecycle.note_stage(txn_id(t), STAGE_COMMITTED)
             batch = Batch(item.epoch, contributions)
             self.batches.append(batch)
             out.append(batch)

@@ -572,6 +572,11 @@ STATE_SCOPE_CLASSES = (
     "sim/network.py::SimNetwork",
     "sim/router.py::Router",
     "crypto/dkg.py::SyncKeyGen",
+    # the txn-latency plane: the plane that watches for leaks must be
+    # provably flat itself — every ledger it keeps is audited too
+    "obs/latency.py::LatencySketch",
+    "obs/latency.py::TxnLifecycle",
+    "obs/latency.py::SloTracker",
 )
 
 # Era-flip path entrypoints: a ``per_era`` attr must have a clear/replace
@@ -728,6 +733,35 @@ STATE_LIFECYCLE = {
         "process_lifetime",
         "one float per simulated epoch; the percentile source for "
         "era-gap bounds and bench attribution",
+    ),
+    "sim/network.py::SimNetwork._slo_cursor": (
+        "process_lifetime",
+        "one consumed-samples cursor per node id; keys mirror "
+        "self.lifecycles (fixed topology), values are ints",
+    ),
+    # -- obs/latency.py (the txn-latency plane) ----------------------------
+    "obs/latency.py::LatencySketch.buckets": (
+        "bounded",
+        "max_buckets collapse-lowest trim loop in add() AND merge()",
+    ),
+    "obs/latency.py::TxnLifecycle.pending": (
+        "bounded", "max_pending popitem(last=False) LRU trim in submit()"
+    ),
+    "obs/latency.py::TxnLifecycle._notes": (
+        "bounded",
+        "notes_cap len() admission guard; drain-swapped each stamp()",
+    ),
+    "obs/latency.py::TxnLifecycle.samples": (
+        "bounded", "samples_cap len() admission guard"
+    ),
+    "obs/latency.py::TxnLifecycle.sketches": (
+        "process_lifetime",
+        "fixed keyset: one LatencySketch per SPANS entry, built whole "
+        "in __init__; _finish feeds values (each bucket-bounded above), "
+        "never inserts keys",
+    ),
+    "obs/latency.py::SloTracker._window": (
+        "bounded", "deque(maxlen=spec.window) construction"
     ),
     # -- sim/router.py::Router ---------------------------------------------
     "sim/router.py::Router._size_cache": (

@@ -256,6 +256,10 @@ class ClusterSupervisor:
         self._feeds: Dict[str, _JsonlFeed] = {}
         os.makedirs(workdir, exist_ok=True)
         clock_skew = clock_skew or {}
+        # kept for the latency-sketch merge: a drifting node's durations
+        # are scaled by its rate, and the fold must undo that (PR 14
+        # alignment stance — offsets cancel in durations, rates don't)
+        self.clock_skew: Dict[int, Tuple[float, float]] = dict(clock_skew)
         for i in range(n):
             env_extra: Dict[str, str] = {}
             if i in clock_skew:
@@ -554,6 +558,27 @@ class ClusterSupervisor:
             merged.counter(name).inc(v)
         return merged
 
+    def merged_sketches(self):
+        """Fold every incarnation's latency sketches into one
+        ``{span: LatencySketch}`` map.  Same grouping discipline as
+        merged_metrics — sketches reset at restart, so each pid's LAST
+        feed is merged, which is exactly what makes the distribution
+        complete across a SIGKILL: the killed incarnation's final
+        summary still carries everything it measured.  A drift-rate
+        node's durations are divided back by its rate before merging
+        (offsets cancel inside durations; rates don't)."""
+        from ..obs.latency import merge_sketch_dicts
+
+        feeds, rates = [], {}
+        for i in range(self.n):
+            _offset, rate = self.clock_skew.get(i, (0.0, 1.0))
+            rates[str(i)] = rate
+            for line in self._last_per_pid(i):
+                sketches = line.get("sketches")
+                if sketches:
+                    feeds.append(dict(sketches, node=str(i)))
+        return merge_sketch_dicts(feeds, rates)
+
     def fault_entries(self) -> List[tuple]:
         """Every child fault-ring kind, shaped for the sim verifier
         ((node, fault-with-.kind) tuples).  The ring rides the summary
@@ -844,6 +869,39 @@ def run_process_chaos(
             "cluster timeline attributed no epoch's critical path"
         )
 
+        # -- the latency plane: cross-node, cross-incarnation merge -----------
+        # each pid's LAST summary line carries that incarnation's full
+        # sketch, so the fold below is complete across the SIGKILL: the
+        # killed incarnation's measurements survive in its final
+        # periodic feed, and the merged distribution must account for
+        # every sample any incarnation ever reported
+        feed_counts: List[int] = []
+        killed_incarnations = 0
+        for i in range(n):
+            lines = sup._last_per_pid(i)
+            if i in {k.node for k in kills if k.sig == "kill"}:
+                killed_incarnations = max(killed_incarnations, len(lines))
+            for line in lines:
+                e2e_feed = (line.get("sketches") or {}).get("e2e") or {}
+                feed_counts.append(int(e2e_feed.get("count", 0)))
+        lat = sup.merged_sketches()
+        e2e_sketch = lat.get("e2e")
+        assert e2e_sketch is not None and e2e_sketch.count > 0, (
+            "process tier measured no submit->commit latency"
+        )
+        assert e2e_sketch.count == sum(feed_counts), (
+            f"cross-incarnation sketch merge dropped samples: merged "
+            f"{e2e_sketch.count} vs {sum(feed_counts)} across feeds"
+        )
+        if any(
+            k.sig == "kill" and k.restart_after_s is not None for k in kills
+        ):
+            assert killed_incarnations >= 2, (
+                "SIGKILLed+restarted node left fewer than two "
+                "incarnation feeds — the latency merge cannot be "
+                "cross-incarnation"
+            )
+
         # -- the contract ------------------------------------------------------
         assert_process_scenario(sup)
         rss1 = rss_mb()
@@ -911,6 +969,15 @@ def run_process_chaos(
             },
             "agreement_ok": True,
             "contract_ok": True,
+            # submit->commit latency, merged across nodes AND across the
+            # killed node's incarnations (drift-rate corrected)
+            "txn_latency": {
+                "count": e2e_sketch.count,
+                "p50_s": round(e2e_sketch.quantile(0.5), 6),
+                "p99_s": round(e2e_sketch.quantile(0.99), 6),
+                "incarnation_feeds": len(feed_counts),
+                "killed_node_incarnations": killed_incarnations,
+            },
         }
     finally:
         try:

@@ -35,6 +35,10 @@ from ..consensus.types import NetworkInfo, Step, quorum_exists
 from ..crypto.dkg import Ack, Part, SyncKeyGen
 from ..crypto.engine import get_engine
 from ..crypto.threshold import PublicKey, SecretKey, Signature
+from ..obs.latency import (
+    STAGE_ADMITTED, STAGE_COMMITTED, STAGE_PROPOSED, SloTracker,
+    TxnLifecycle, txn_id,
+)
 from ..obs.logging import get_logger
 from ..obs.metrics import MetricsRegistry
 from ..obs.recorder import resolve as _resolve_recorder
@@ -322,6 +326,13 @@ class Hydrabadger:
             node=self.uid.bytes.hex()[:8]
         )
         self.metrics = MetricsRegistry()
+        # transaction-latency plane (obs/latency.py): this node IS the
+        # I/O boundary, so submit/admitted/proposed/committed all stamp
+        # inline on wall_now() — the same skewed-wall clock every other
+        # feed reads, so the aggregator's alignment genuinely applies
+        self.txn_lifecycle = TxnLifecycle()
+        # SLO evaluation is opt-in per harness: install via set_slo()
+        self._slo_tracker: Optional[SloTracker] = None
         # seed=None must mean real entropy: the uid is broadcast in every
         # hello frame, so deriving the RNG (hence the identity secret key
         # and encryption randomness) from it would be publicly replayable.
@@ -500,6 +511,13 @@ class Hydrabadger:
             return False
         self._internal_put(("api_vote", tuple(change)))
         return True
+
+    def set_slo(self, spec) -> None:
+        """Install a latency SLO (obs/latency.SloSpec): evaluated at
+        every committed batch; burn-rate violations land in the fault
+        ring + slo_violations counter — LOUD, per the observability
+        contract (silent SLO tolerance is a failure)."""
+        self._slo_tracker = SloTracker(spec) if spec is not None else None
 
     def submit_transaction(self, txn: bytes) -> bool:
         """Inject a raw transaction (reference Transaction relay).
@@ -1011,6 +1029,7 @@ class Hydrabadger:
             # it — a direct propose() here would be silently swallowed
             # by hb.has_input (a real starvation: user contributions on
             # a generator-driven node could miss every epoch forever).
+            self._note_txn_submit(bytes(item[1]))
             self._pending_user.append(bytes(item[1]))
             self._flush_user_contributions()
         elif kind == "api_vote":
@@ -1954,9 +1973,85 @@ class Hydrabadger:
             # anything else rides opaquely and atomically
             elements.extend(flat if flat is not None else [payload])
         self._pending_user.clear()
+        # the flush moment is both admission into a contribution and
+        # the proposal itself (DHB has no intermediate queue): stamp
+        # both stages here, so submit→admitted carries the
+        # _pending_user queueing delay
+        for t in elements:
+            tid = txn_id(t)
+            self.txn_lifecycle.note_stage(tid, STAGE_ADMITTED)
+            self.txn_lifecycle.note_stage(tid, STAGE_PROPOSED)
+        self.txn_lifecycle.stamp(self.wall_now())
         self._dispatch_step(
             self.dhb.propose(codec.encode(tuple(elements)), self.rng)
         )
+
+    def _note_txn_submit(self, payload: bytes) -> None:
+        """Stamp submission PER TXN at enqueue (satellite of the
+        latency plane): generator payloads are codec tuples of txns and
+        split to individual ids; opaque user payloads ride as one txn.
+        A deduplicated resubmission keeps the original's stamp and
+        counts separately — re-stamping would erase queueing delay."""
+        from ..utils import codec
+
+        txns = None
+        try:
+            items = codec.decode(payload)
+            if isinstance(items, tuple) and all(
+                isinstance(x, (bytes, bytearray, memoryview)) for x in items
+            ):
+                txns = [bytes(x) for x in items]
+        except (ValueError, TypeError):
+            pass
+        now = self.wall_now()
+        for t in txns if txns is not None else [payload]:
+            if not self.txn_lifecycle.submit(txn_id(t), now):
+                self.metrics.counter("txn_resubmitted").inc()
+
+    def _note_txn_commits(self, batch: DhbBatch) -> None:
+        """Close lifecycle records for every txn in the committed batch
+        (codec-tuple contributions carry per-txn identity; opaque
+        payloads close as single txns), mirror lifecycle counts and the
+        txn_latency_* percentile gauges from this node's e2e sketch,
+        and evaluate the installed SLO — a burn-rate violation is a
+        LOUD fault-ring entry, not a log line."""
+        from ..utils import codec
+
+        lc = self.txn_lifecycle
+        for payload in batch.contributions.values():
+            txns = None
+            try:
+                items = codec.decode(bytes(payload))
+                if isinstance(items, tuple) and all(
+                    isinstance(x, (bytes, bytearray, memoryview))
+                    for x in items
+                ):
+                    txns = [bytes(x) for x in items]
+            except (ValueError, TypeError):
+                pass  # opaque payload: closes as a single txn below
+            for t in txns if txns is not None else [bytes(payload)]:
+                lc.note_stage(txn_id(t), STAGE_COMMITTED)
+        before = len(lc.samples)
+        lc.stamp(self.wall_now())
+        # lifetime values mirrored with set, not inc: the lifecycle
+        # holds the cumulative truth (the meter_bytes idiom)
+        self.metrics.counter("txn_submitted").value = lc.submitted
+        self.metrics.counter("txn_committed").value = lc.committed_count
+        e2e_sketch = lc.sketches["e2e"]
+        if e2e_sketch.count:
+            p = e2e_sketch.percentiles()
+            self.metrics.gauge("txn_latency_p50_s").track(round(p["p50"], 6))
+            self.metrics.gauge("txn_latency_p90_s").track(round(p["p90"], 6))
+            self.metrics.gauge("txn_latency_p99_s").track(round(p["p99"], 6))
+            self.metrics.gauge("txn_latency_p999_s").track(
+                round(p["p999"], 6)
+            )
+        if self._slo_tracker is not None:
+            for v in lc.samples[before:]:
+                self._slo_tracker.observe(v)
+            msg = self._slo_tracker.check()
+            if msg is not None:
+                self._note_fault(msg, "slo_violations")
 
     def _on_batch(self, batch: DhbBatch) -> None:
         if self.keygen_outbox and self.dhb.era != self.cfg.start_epoch:
@@ -2012,7 +2107,13 @@ class Hydrabadger:
         self._replay_backoff = 1.0
         self._replayed_since_progress = False
         self.metrics.counter("epochs_committed").inc()
-        self.metrics.histogram("epoch_duration_s").observe(dt)
+        # the UNCLAMPED duration: the 60 s clamp protects the stall-EMA
+        # above, but feeding it here erased the tail the histogram
+        # exists to show (config 12's 80 s fault-load gap read as 60 s
+        # in the overflow bucket).  The histogram's sketch twin keeps
+        # the real p99 a real number at any magnitude.
+        self.metrics.histogram("epoch_duration_s").observe(raw_dt)
+        self._note_txn_commits(batch)
         self.obs.instant(
             "epoch_commit",
             epoch=batch.epoch,

@@ -315,6 +315,34 @@ def stage_spans(events: List[Event]) -> List[dict]:
     return spans
 
 
+def _decompose(
+    spans: List[dict], t0: float, t1: float
+) -> Dict[str, float]:
+    """Partition the straggler's epoch window [t0, t1] across its leaf
+    stage spans: each instant is attributed to the INNERMOST active
+    stage (latest begin wins — a tdec running inside an epoch's tail
+    owns that time, not the long-finished rbc), un-covered time is
+    ``other``.  A partition by construction: the values sum to exactly
+    t1 - t0, which is what lets the report assert the decomposition
+    against the measured end-to-end instead of hand-waving it."""
+    clipped = [
+        (max(s["t0"], t0), min(s["t1"], t1), s["name"])
+        for s in spans
+        if s["t1"] is not None and s["name"] != "subset"
+        and min(s["t1"], t1) > max(s["t0"], t0)
+    ]
+    out: Dict[str, float] = {"other": 0.0}
+    cuts = sorted({t0, t1, *(a for a, _b, _n in clipped),
+                   *(b for _a, b, _n in clipped)})
+    for a, b in zip(cuts, cuts[1:]):
+        if b <= t0 or a >= t1:
+            continue
+        active = [(sa, name) for sa, sb, name in clipped if sa <= a and sb >= b]
+        name = max(active)[1] if active else "other"
+        out[name] = out.get(name, 0.0) + (b - a)
+    return {k: round(v, 6) for k, v in out.items()}
+
+
 def critical_path(events: List[Event]) -> List[dict]:
     """Per committed epoch: the straggler node (last aligned ``epoch``
     span end) and the stage span that gated it — the last
@@ -322,13 +350,15 @@ def critical_path(events: List[Event]) -> List[dict]:
     commit.  Epochs only one node committed (trace windows differ) are
     skipped for straggler purposes but still reported."""
     commits: Dict[tuple, Dict[str, float]] = {}
+    begins: Dict[tuple, Dict[str, float]] = {}
     for ev in events:
-        if ev.name == "epoch" and ev.phase == "E" and ev.t is not None:
+        if ev.name == "epoch" and ev.phase in ("B", "E") and ev.t is not None:
             key = (ev.attrs.get("era", 0), ev.attrs.get("epoch"))
             if key[1] is None:
                 continue
             node = _nkey(ev.attrs.get("node", "?"))
-            commits.setdefault(key, {})[node] = ev.t
+            table = commits if ev.phase == "E" else begins
+            table.setdefault(key, {})[node] = ev.t
     by_owner: Dict[tuple, List[dict]] = {}
     for span in stage_spans(events):
         if span["t1"] is None:
@@ -354,6 +384,21 @@ def critical_path(events: List[Event]) -> List[dict]:
         leaves = [s for s in cands if s["name"] != "subset"]
         cands = leaves or cands
         gate = max(cands, key=lambda s: s["t1"]) if cands else None
+        # stage decomposition: the straggler's epoch window, partitioned
+        # across its leaf stage spans (epoch-B anchored; falls back to
+        # the earliest stage begin when the B event fell outside the
+        # trace window — then e2e under-counts honestly rather than
+        # inventing an anchor)
+        t_begin = begins.get(key, {}).get(straggler)
+        if t_begin is None and cands:
+            t_begin = min(s["t0"] for s in cands)
+        stages = (
+            _decompose(
+                by_owner.get((key[0], key[1], straggler), []),
+                t_begin, t_commit,
+            )
+            if t_begin is not None and t_begin < t_commit else {}
+        )
         rows.append(
             {
                 "era": key[0],
@@ -366,6 +411,11 @@ def critical_path(events: List[Event]) -> List[dict]:
                     t_commit - min(nodes.values()), 6
                 ),
                 "nodes_committed": len(nodes),
+                "e2e_s": (
+                    round(t_commit - t_begin, 6)
+                    if t_begin is not None else None
+                ),
+                "stages_s": stages,
             }
         )
     return rows
@@ -450,6 +500,17 @@ def timeline_report(
     lat = message_latency(events)
     nodes = sorted({_nkey(e.attrs["node"]) for e in events if "node" in e.attrs})
     multi = [r for r in epochs if r["nodes_committed"] > 1]
+    # per-stage attribution folded across epochs: where committed wall
+    # time actually went.  Each epoch's partition sums to its e2e by
+    # construction, so the totals sum to total attributed e2e too.
+    stage_totals: Dict[str, float] = {}
+    attributed_e2e = 0.0
+    for r in epochs:
+        if not r["stages_s"]:
+            continue
+        attributed_e2e += r["e2e_s"] or 0.0
+        for name, v in r["stages_s"].items():
+            stage_totals[name] = stage_totals.get(name, 0.0) + v
     return {
         "nodes": nodes,
         "events": len(events),
@@ -471,6 +532,10 @@ def timeline_report(
         "commit_spread_max_s": round(
             max((r["commit_spread_s"] for r in multi), default=0.0), 6
         ),
+        "stage_totals_s": {
+            k: round(v, 6) for k, v in sorted(stage_totals.items())
+        },
+        "stage_e2e_s": round(attributed_e2e, 6),
         **lat,
     }
 

@@ -1,0 +1,420 @@
+"""Transaction-latency plane: submit→committed SLOs on mergeable
+quantile sketches, with per-stage attribution (ROADMAP item 1).
+
+Every metric before this plane was node-centric (epochs/s, commit gap,
+bytes/epoch); clients judge the system by *their* latency — the wall
+time from handing a transaction over to seeing it in a committed
+batch.  Three pieces live here, all deliberately free of clock reads
+(times arrive as parameters from the I/O boundary that owns the clock,
+the same seam discipline as ``obs/recorder.py``):
+
+  * ``LatencySketch`` — a DDSketch-style log-bucketed quantile sketch:
+    relative-error-bounded quantiles in bounded memory, and *mergeable*
+    (merge = bucket-wise add), so per-node sketches fold across nodes
+    and across SIGKILL'd incarnations the way counters already do in
+    the summary feeds.  ``scale()`` shifts the whole distribution by a
+    clock-rate factor — drift alignment before a cross-node merge
+    (offsets cancel inside a duration; rates scale it).
+
+  * ``TxnLifecycle`` — the per-node lifecycle ledger.  Sans-io cores
+    ``note_stage(txn_id, stage)`` inclusion events with NO timestamps; the
+    I/O boundary calls ``stamp(t)`` to resolve the buffered notes at
+    the moment it owns, and ``submit(txn_id, t)`` directly (submission
+    IS a boundary event).  A committed note closes the record and
+    feeds the span sketches.  Both the pending ledger and the note
+    buffer are bounded — the latency plane must never become the
+    memory leak it exists to observe.
+
+  * ``SloSpec`` / ``SloTracker`` — a target percentile + threshold +
+    burn-rate window, evaluated continuously: the tracker windows
+    over-threshold commits and flags when the over-budget fraction
+    burns faster than the percentile allows.  Violations are LOUD
+    (fault ring / counters at the call site); silent SLO tolerance is
+    a failure by the same contract as fault observability.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+# 1% relative error keeps p99 of a 10 s tail within ~100 ms — far
+# inside the 2% sketch-vs-exact budget bench config 17 asserts.
+DEFAULT_REL_ERR = 0.01
+# ~2k buckets span 1 ms .. days at 1% error with room to spare; the
+# collapse trim below makes this a hard cap, not a hope.
+DEFAULT_MAX_BUCKETS = 2048
+
+# Lifecycle stages, in causal order.  ``submit`` and the stamps are
+# boundary-owned; ``admitted``/``proposed``/``committed`` are core
+# notes resolved at the next boundary stamp.
+STAGE_SUBMIT = "submit"
+STAGE_ADMITTED = "admitted"
+STAGE_PROPOSED = "proposed"
+STAGE_COMMITTED = "committed"
+
+# (span name, start stage, end stage): e2e plus the three lifecycle
+# deltas.  These are the sketch keys in feeds and merged reports.
+SPANS: Tuple[Tuple[str, str, str], ...] = (
+    ("e2e", STAGE_SUBMIT, STAGE_COMMITTED),
+    ("admission", STAGE_SUBMIT, STAGE_ADMITTED),
+    ("propose_wait", STAGE_ADMITTED, STAGE_PROPOSED),
+    ("consensus", STAGE_PROPOSED, STAGE_COMMITTED),
+)
+
+PERCENTILES: Tuple[Tuple[str, float], ...] = (
+    ("p50", 0.50), ("p90", 0.90), ("p99", 0.99), ("p999", 0.999),
+)
+
+
+def txn_id(txn: bytes) -> str:
+    """Compact identity tag for a transaction payload: 8-byte blake2b,
+    hex.  Cheap enough to compute at every boundary, collision-safe at
+    any realistic in-flight population (~1e-10 at a million pending)."""
+    return hashlib.blake2b(bytes(txn), digest_size=8).hexdigest()
+
+
+class LatencySketch:
+    """DDSketch-style relative-error quantile sketch.
+
+    Values map to log-spaced buckets ``index = ceil(log_gamma(v))``
+    with ``gamma = (1+rel_err)/(1-rel_err)``; any quantile estimate is
+    within ``rel_err`` of the true value, relatively.  Memory is
+    bounded by ``max_buckets``: over-cap, the lowest two buckets
+    collapse (tail accuracy is the product; the head absorbs the
+    error).  Merging is bucket-wise addition, so sketches fold across
+    nodes, incarnations and soak rows exactly like counters do.
+    """
+
+    def __init__(self, rel_err: float = DEFAULT_REL_ERR,
+                 max_buckets: int = DEFAULT_MAX_BUCKETS):
+        if not 0.0 < rel_err < 1.0:
+            raise ValueError("rel_err must be in (0, 1)")
+        self.rel_err = float(rel_err)
+        self.gamma = (1.0 + self.rel_err) / (1.0 - self.rel_err)
+        self._log_gamma = math.log(self.gamma)
+        self.max_buckets = int(max_buckets)
+        self.buckets: Dict[int, int] = {}
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # values below this are indistinguishable from zero for latency
+    # purposes and would otherwise mint extreme negative indices
+    _ZERO_EPS = 1e-9
+
+    def add(self, v: float, n: int = 1) -> None:
+        v = float(v)
+        self.count += n
+        self.sum += v * n
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= self._ZERO_EPS:
+            self.zero_count += n
+            return
+        idx = math.ceil(math.log(v) / self._log_gamma)
+        self.buckets[idx] = self.buckets.get(idx, 0) + n
+        while len(self.buckets) > self.max_buckets:
+            lo = min(self.buckets)
+            spill = self.buckets.pop(lo)
+            nxt = min(self.buckets)
+            self.buckets[nxt] = self.buckets.get(nxt, 0) + spill
+
+    def merge(self, other: "LatencySketch") -> None:
+        if abs(other.gamma - self.gamma) > 1e-12:
+            raise ValueError(
+                "cannot merge sketches with different rel_err"
+            )
+        self.zero_count += other.zero_count
+        self.count += other.count
+        self.sum += other.sum
+        if other.min < self.min:
+            self.min = other.min
+        if other.max > self.max:
+            self.max = other.max
+        for idx, c in other.buckets.items():
+            self.buckets[idx] = self.buckets.get(idx, 0) + c
+        while len(self.buckets) > self.max_buckets:
+            lo = min(self.buckets)
+            spill = self.buckets.pop(lo)
+            nxt = min(self.buckets)
+            self.buckets[nxt] = self.buckets.get(nxt, 0) + spill
+
+    def scale(self, factor: float) -> "LatencySketch":
+        """Multiply the whole distribution by ``factor`` — clock-rate
+        (drift) alignment before a cross-node merge.  A duration read
+        on a clock running at rate ``r`` is ``r×`` the true duration;
+        ``scale(1/r)`` restores it.  Log buckets make this an index
+        shift (quantized to one bucket, i.e. within ``rel_err``)."""
+        if factor <= 0.0:
+            raise ValueError("scale factor must be positive")
+        if factor != 1.0 and self.buckets:
+            shift = int(round(math.log(factor) / self._log_gamma))
+            self.buckets = {
+                idx + shift: c for idx, c in self.buckets.items()
+            }
+        if factor != 1.0:
+            self.sum *= factor
+            if self.count:
+                self.min = self.min * factor
+                self.max = self.max * factor
+        return self
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile; None when empty.  The estimate is
+        the geometric bucket midpoint, clamped to the observed
+        [min, max] so single-sample and edge quantiles stay exact."""
+        if self.count == 0:
+            return None
+        rank = q * (self.count - 1)
+        if rank < self.zero_count:
+            return 0.0
+        seen = float(self.zero_count)
+        for idx in sorted(self.buckets):
+            seen += self.buckets[idx]
+            if seen > rank:
+                v = 2.0 * self.gamma ** idx / (self.gamma + 1.0)
+                return min(max(v, self.min), self.max)
+        return self.max if self.max > -math.inf else None
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        return {name: self.quantile(q) for name, q in PERCENTILES}
+
+    def to_dict(self) -> dict:
+        """JSON-able form for summary feeds and soak rows."""
+        return {
+            "rel_err": self.rel_err,
+            "zero": self.zero_count,
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "buckets": {str(idx): c for idx, c in sorted(self.buckets.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "LatencySketch":
+        sketch = cls(rel_err=float(d.get("rel_err", DEFAULT_REL_ERR)))
+        sketch.zero_count = int(d.get("zero", 0))
+        sketch.count = int(d.get("count", 0))
+        sketch.sum = float(d.get("sum", 0.0))
+        mn, mx = d.get("min"), d.get("max")
+        sketch.min = math.inf if mn is None else float(mn)
+        sketch.max = -math.inf if mx is None else float(mx)
+        sketch.buckets = {
+            int(idx): int(c) for idx, c in (d.get("buckets") or {}).items()
+        }
+        return sketch
+
+
+def merge_sketch_dicts(
+    feeds: Iterable[Mapping],
+    rates: Optional[Mapping[str, float]] = None,
+) -> Dict[str, LatencySketch]:
+    """Fold per-node ``{span: sketch_dict}`` feeds (each optionally
+    tagged with the node id under ``"node"``) into one sketch per
+    span, applying per-node clock-RATE correction before the merge —
+    the PR 14 alignment stance: offsets cancel inside a duration,
+    rates scale it, so only the rate needs undoing."""
+    merged: Dict[str, LatencySketch] = {}
+    for feed in feeds:
+        node = feed.get("node") if isinstance(feed, Mapping) else None
+        rate = float((rates or {}).get(node, 1.0)) if node is not None else 1.0
+        for span, payload in feed.items():
+            if span == "node" or not isinstance(payload, Mapping):
+                continue
+            sketch = LatencySketch.from_dict(payload)
+            if rate not in (0.0, 1.0):
+                sketch.scale(1.0 / rate)
+            if span in merged:
+                merged[span].merge(sketch)
+            else:
+                merged[span] = sketch
+    return merged
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """A latency SLO: "the ``percentile`` of submit→committed latency
+    stays under ``threshold_s``", judged over a sliding ``window`` of
+    commits.  The error budget is ``1 - percentile``; the burn rate is
+    the windowed over-threshold fraction divided by that budget — a
+    burn rate > 1 means the tail is eating budget faster than the SLO
+    allows, i.e. a violation."""
+
+    name: str = "txn_latency"
+    percentile: float = 0.99
+    threshold_s: float = 5.0
+    window: int = 256
+    min_samples: int = 16
+
+    @property
+    def budget(self) -> float:
+        return max(1.0 - self.percentile, 1e-9)
+
+
+class SloTracker:
+    """Continuous SLO evaluation over a bounded commit window.  Callers
+    ``observe()`` each committed e2e latency and ``check()`` at their
+    own cadence; a non-None check result is the violation message to
+    push LOUDLY through the fault ring."""
+
+    def __init__(self, spec: SloSpec):
+        self.spec = spec
+        self._window: deque = deque(maxlen=int(spec.window))
+        self.violations = 0
+
+    def observe(self, latency_s: float) -> None:
+        self._window.append(1 if latency_s > self.spec.threshold_s else 0)
+
+    def burn_rate(self) -> float:
+        if not self._window:
+            return 0.0
+        frac = sum(self._window) / float(len(self._window))
+        return frac / self.spec.budget
+
+    def check(self) -> Optional[str]:
+        if len(self._window) < self.spec.min_samples:
+            return None
+        rate = self.burn_rate()
+        if rate <= 1.0:
+            return None
+        self.violations += 1
+        return (
+            "slo violation: %s p%g > %.3fs (burn rate %.1fx budget "
+            "over last %d commits)"
+            % (self.spec.name, self.spec.percentile * 100.0,
+               self.spec.threshold_s, rate, len(self._window))
+        )
+
+
+class TxnLifecycle:
+    """Per-node transaction lifecycle ledger (sans-io core side +
+    boundary side in one object, per the recorder's split).
+
+    Core side (NO clock):  ``note_stage(txn_id, stage)`` buffers an
+    identity-tagged inclusion event.  Boundary side (owns the clock):
+    ``submit(txn_id, t)`` opens a record at submission time and
+    ``stamp(t)`` resolves every buffered note to the boundary's
+    moment.  A ``committed`` note closes the record into the span
+    sketches; only the submitting node holds the record, so foreign
+    committed notes resolve to nothing — cross-node latency merge
+    happens at the sketch layer, not here.
+
+    Everything growable is bounded: ``pending`` is an LRU (oldest
+    in-flight record evicted over cap — a txn the network never
+    commits must not pin memory forever), ``_notes`` admission-guarded,
+    ``samples`` (exact e2e retention for sketch-error audits)
+    admission-guarded.
+    """
+
+    def __init__(self, rel_err: float = DEFAULT_REL_ERR,
+                 max_pending: int = 1 << 14,
+                 notes_cap: int = 1 << 16,
+                 samples_cap: int = 1 << 17):
+        self.rel_err = float(rel_err)
+        self.max_pending = int(max_pending)
+        self.notes_cap = int(notes_cap)
+        self.samples_cap = int(samples_cap)
+        self.pending: "OrderedDict[str, Dict[str, float]]" = OrderedDict()
+        self._notes: List[Tuple[str, str]] = []
+        self.sketches: Dict[str, LatencySketch] = {
+            name: LatencySketch(self.rel_err) for name, _, _ in SPANS
+        }
+        self.samples: List[float] = []
+        self.submitted = 0
+        self.resubmitted = 0
+        self.committed_count = 0
+        self.dropped_notes = 0
+        self.evicted_pending = 0
+
+    # -- boundary side ------------------------------------------------
+
+    def submit(self, tid: str, t: float) -> bool:
+        """Open a record at submission time ``t``.  Returns False (and
+        counts a resubmission) when the id is already in flight — the
+        dedup path must NOT re-stamp, or queueing delay of the
+        original would be erased."""
+        if tid in self.pending:
+            self.resubmitted += 1
+            return False
+        self.pending[tid] = {STAGE_SUBMIT: float(t)}
+        self.submitted += 1
+        while len(self.pending) > self.max_pending:
+            self.pending.popitem(last=False)
+            self.evicted_pending += 1
+        return True
+
+    def stamp(self, t: float) -> int:
+        """Resolve every buffered core note to boundary time ``t``.
+        Returns the number of notes that matched an open record."""
+        if not self._notes:
+            return 0
+        notes, self._notes = self._notes, []
+        resolved = 0
+        t = float(t)
+        for tid, stage in notes:
+            rec = self.pending.get(tid)
+            if rec is None or stage in rec:
+                continue  # foreign txn, or a duplicate stage note
+            rec[stage] = t
+            resolved += 1
+            if stage == STAGE_COMMITTED:
+                self._finish(tid, rec)
+        return resolved
+
+    # -- core side (sans-io: never reads a clock) ---------------------
+
+    def note_stage(self, tid: str, stage: str) -> None:
+        if len(self._notes) < self.notes_cap:
+            self._notes.append((tid, stage))
+        else:
+            self.dropped_notes += 1
+
+    # -- internals ----------------------------------------------------
+
+    def _finish(self, tid: str, rec: Dict[str, float]) -> None:
+        self.pending.pop(tid, None)
+        self.committed_count += 1
+        for name, start, end in SPANS:
+            t0 = rec.get(start)
+            t1 = rec.get(end)
+            if t0 is None or t1 is None:
+                continue
+            self.sketches[name].add(max(t1 - t0, 0.0))
+        t0 = rec.get(STAGE_SUBMIT)
+        t1 = rec.get(STAGE_COMMITTED)
+        if t0 is not None and t1 is not None:
+            if len(self.samples) < self.samples_cap:
+                self.samples.append(max(t1 - t0, 0.0))
+
+    # -- export -------------------------------------------------------
+
+    def sketch_feed(self) -> Dict[str, dict]:
+        """``{span: sketch_dict}`` — the JSON-able per-node feed shape
+        ``merge_sketch_dicts`` folds."""
+        return {name: s.to_dict() for name, s in self.sketches.items()}
+
+    def e2e_percentiles(self) -> Dict[str, Optional[float]]:
+        return self.sketches["e2e"].percentiles()
+
+
+def exact_quantile(samples: List[float], q: float) -> Optional[float]:
+    """Exact quantile — the ground truth the bench config-17
+    sketch-error assertion compares against.  Nearest-rank with the
+    SKETCH's convention (rank = q*(n-1), floor), deliberately NOT
+    interpolated: the DDSketch guarantee bounds the relative error of
+    the value AT a rank, so the comparison must pick the same rank —
+    interpolating across a gap between two latency clusters (e.g. two
+    epochs' commit walls) would manufacture a mid-gap "truth" no sample
+    ever took and report convention skew as sketch error."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[int(math.floor(q * (len(s) - 1)))]

@@ -16,6 +16,12 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .latency import LatencySketch
+
+
+def _round_q(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 6)
+
 
 # hbasync overlap gauges, stamped by crypto/futures at every submit /
 # fetch boundary.  The names are fixed HERE so every surface that reads
@@ -270,6 +276,35 @@ MSM_REAL_LANES = "msm_real_lanes"
 STATE_CENSUS_PREFIX = "state_census_"
 RETRACE_SIGS_PREFIX = "retrace_sigs_"
 
+# Transaction-latency plane (obs/latency.py, ROADMAP item 1): the
+# client-observed submit→committed distribution, kept in mergeable
+# quantile sketches and exported as percentile gauges.  One spelling
+# here so the sim tier, the TCP node, the process-tier merged feeds,
+# bench config 17 and the SLO soak gate all read the same names:
+#
+#   TXN_LATENCY_P50_S .. P999_S — submit→committed latency percentiles
+#       in seconds, re-derived from the node's e2e sketch at every
+#       commit (gauge semantics: last value + high-water).
+#   TXN_SUBMITTED — transactions that opened a lifecycle record (fresh
+#       submissions only).
+#   TXN_RESUBMITTED — deduplicated resubmissions: an id already in
+#       flight was submitted again.  Counted SEPARATELY from fresh
+#       submissions so queueing-delay math never re-stamps the
+#       original's clock (the satellite-6 fix).
+#   TXN_COMMITTED — lifecycle records closed by committed-batch
+#       membership (the sketch's sample count).
+#   SLO_VIOLATIONS — SloTracker burn-rate violations pushed through
+#       the fault ring.  The SLO contract mirrors fault observability:
+#       a chaos run that breaches the SLO silently is a FAILURE.
+TXN_LATENCY_P50_S = "txn_latency_p50_s"
+TXN_LATENCY_P90_S = "txn_latency_p90_s"
+TXN_LATENCY_P99_S = "txn_latency_p99_s"
+TXN_LATENCY_P999_S = "txn_latency_p999_s"
+TXN_SUBMITTED = "txn_submitted"
+TXN_RESUBMITTED = "txn_resubmitted"
+TXN_COMMITTED = "txn_committed"
+SLO_VIOLATIONS = "slo_violations"
+
 
 class Counter:
     __slots__ = ("value",)
@@ -308,9 +343,17 @@ DEFAULT_EDGES: Tuple[float, ...] = (
 class Histogram:
     """Fixed-edge histogram: ``counts[i]`` counts observations ``v``
     with ``edges[i-1] < v <= edges[i]``; ``counts[0]`` is ``v <=
-    edges[0]`` and ``counts[-1]`` the overflow bucket."""
+    edges[0]`` and ``counts[-1]`` the overflow bucket.
 
-    __slots__ = ("edges", "counts", "total", "sum")
+    Backed by a ``LatencySketch`` twin since the latency plane landed:
+    fixed edges lose the tail under fault loads (config 12's 80 s
+    commit gap vanished into the >60 s overflow bucket — "p99 > 60 s"
+    is not a number).  The sketch sees every ``observe`` and serves
+    real relative-error quantiles via ``quantile``; the fixed-edge
+    counts stay exported unchanged, so the snapshot schema is strictly
+    additive (old readers keep working)."""
+
+    __slots__ = ("edges", "counts", "total", "sum", "sketch")
 
     def __init__(self, edges: Optional[Sequence[float]] = None):
         self.edges: Tuple[float, ...] = tuple(edges or DEFAULT_EDGES)
@@ -319,6 +362,7 @@ class Histogram:
         self.counts: List[int] = [0] * (len(self.edges) + 1)
         self.total = 0
         self.sum = 0.0
+        self.sketch = LatencySketch()
 
     def observe(self, v: float) -> None:
         i = 0
@@ -329,6 +373,10 @@ class Histogram:
         self.counts[i] += 1
         self.total += 1
         self.sum += v
+        self.sketch.add(v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self.sketch.quantile(q)
 
 
 class MetricsRegistry:
@@ -389,6 +437,13 @@ class MetricsRegistry:
                     "counts": list(h.counts),
                     "total": h.total,
                     "sum": round(h.sum, 6),
+                    # additive since the latency plane: real sketch-
+                    # backed tail quantiles + the mergeable sketch
+                    # itself (soak's cross-node fold needs the buckets,
+                    # not just the point estimates)
+                    "p50": _round_q(h.quantile(0.5)),
+                    "p99": _round_q(h.quantile(0.99)),
+                    "sketch": h.sketch.to_dict(),
                 }
                 for k, h in sorted(self._histograms.items())
             },

@@ -23,9 +23,11 @@ from typing import Callable, Dict, List, Optional
 
 from ..consensus.dynamic_honey_badger import DynamicHoneyBadger
 from ..consensus.queueing import QueueingHoneyBadger
-from ..consensus.types import NetworkInfo
+from ..consensus.types import Fault, NetworkInfo
 from ..crypto import threshold as th
 from ..crypto.engine import get_engine
+from ..obs import metrics as M
+from ..obs.latency import LatencySketch, SloTracker, TxnLifecycle, txn_id
 from ..obs.metrics import MetricsRegistry
 from ..obs.recorder import NULL_RECORDER, Recorder
 from .router import Router
@@ -113,6 +115,20 @@ class SimConfig:
     # costs wall on the hot path; bench config 14 and the rbc soak
     # gate turn it on.
     meter_bytes: bool = False
+    # transaction-latency plane (obs/latency.py): per-txn lifecycle
+    # ledgers on every qhb node — submission stamped PER TXN at
+    # enqueue, admitted/proposed/committed noted sans-io by the core
+    # and stamped at the router's delivery boundary.  On by default
+    # (one 8-byte blake2b per txn per stage — microseconds against a
+    # millisecond epoch); qhb only: the dhb sim workload proposes
+    # opaque concatenated payloads with no per-txn identity (the TCP
+    # tier's dhb path carries it via codec tuples).
+    txn_latency: bool = True
+    # optional SLO spec (obs/latency.SloSpec) evaluated continuously
+    # at every epoch boundary: a burn-rate violation increments
+    # slo_violations AND lands in the router fault ring — the same
+    # LOUD-tolerance stance as the fault-observability contract.
+    slo: Optional[object] = None
 
 
 @contextmanager
@@ -160,6 +176,10 @@ class SimMetrics:
     latency_p50_ms: float = 0.0
     latency_p90_ms: float = 0.0
     latency_p99_ms: float = 0.0
+    # client-observed submit→committed latency (obs/latency.py), the
+    # cross-node sketch merge: p50/p90/p99/p999 seconds + lifecycle
+    # counts.  Empty when the lifecycle plane is off (dhb sim).
+    txn_latency: Dict[str, float] = field(default_factory=dict)
 
     @property
     def epochs_per_sec(self) -> float:
@@ -200,6 +220,7 @@ class SimMetrics:
             "latency_p50_ms": round(self.latency_p50_ms, 3),
             "latency_p90_ms": round(self.latency_p90_ms, 3),
             "latency_p99_ms": round(self.latency_p99_ms, 3),
+            "txn_latency": dict(self.txn_latency),
         }
 
 
@@ -244,6 +265,17 @@ class SimNetwork:
             else NULL_RECORDER
         )
         self.metrics = MetricsRegistry()
+        # transaction-latency plane: one sans-io lifecycle ledger per
+        # node, noted by the core and stamped at the router's delivery
+        # boundary + the epoch tick (obs/latency.py)
+        self.lifecycles: Dict = (
+            {nid: TxnLifecycle() for nid in self.ids}
+            if cfg.protocol == "qhb" and getattr(cfg, "txn_latency", True)
+            else {}
+        )
+        slo = getattr(cfg, "slo", None)
+        self.slo_tracker = SloTracker(slo) if slo is not None else None
+        self._slo_cursor: Dict = {nid: 0 for nid in self.lifecycles}
         if cfg.protocol == "qhb":
             self.nodes: Dict = {
                 nid: QueueingHoneyBadger(
@@ -255,6 +287,7 @@ class SimNetwork:
                     engine=engine,
                     recorder=self.recorder.bind(node=nid),
                     rbc_variant=self.rbc_variant,
+                    lifecycle=self.lifecycles.get(nid),
                 )
                 for nid in self.ids
             }
@@ -334,6 +367,9 @@ class SimNetwork:
         # work at each quiescence, so completions submitted during a
         # tick drain before the next tick's proposals
         self.router.drain_hook = self._drain_async
+        # the delivery loop stamps the recipient's buffered lifecycle
+        # notes with the same clock read the recorder gets
+        self.router.lifecycles = self.lifecycles
         self._txn_counter = 0
         self.total_wall_s = 0.0  # cumulative across run() calls / resumes
         self.epoch_durations: List[float] = []  # seconds, per run_epoch
@@ -388,6 +424,11 @@ class SimNetwork:
             ),
         )
         self.__dict__.setdefault("_steady_durations", [])
+        self.__dict__.setdefault("lifecycles", {})
+        self.__dict__.setdefault("slo_tracker", None)
+        self.__dict__.setdefault("_slo_cursor", {})
+        if not hasattr(self.router, "lifecycles"):
+            self.router.lifecycles = self.lifecycles
         if "census" not in self.__dict__:
             from ..obs.census import StateCensus
 
@@ -474,8 +515,15 @@ class SimNetwork:
         cfg = self.cfg
         if cfg.protocol == "qhb":
             for nid in self.ids:
+                lc = self.lifecycles.get(nid)
                 for _ in range(cfg.txns_per_node_per_epoch):
-                    self.nodes[nid].push_transaction(self._gen_txn())
+                    txn = self._gen_txn()
+                    # same per-txn enqueue stamp as the message plane
+                    if lc is not None and not lc.submit(
+                        txn_id(txn), time.perf_counter()
+                    ):
+                        self.metrics.counter(M.TXN_RESUBMITTED).inc()
+                    self.nodes[nid].push_transaction(txn)
             validators = list(self.ids)
             payloads = [
                 self.nodes[nid].external_contribution(self.rng)
@@ -516,6 +564,12 @@ class SimNetwork:
             # tier today, but keep the plane closed if they ever do)
             if step.messages:
                 self.router.dispatch_step(nid, step)
+        if self.lifecycles:
+            # the native world has no per-delivery boundary: the batch
+            # application IS the commit moment for this epoch
+            now = time.perf_counter()
+            for lc in self.lifecycles.values():
+                lc.stamp(now)
         if self.router.queue:
             self.router.run(
                 self.cfg.max_messages_per_epoch
@@ -540,6 +594,11 @@ class SimNetwork:
         # boundary is the sim's other I/O boundary
         if self.recorder.enabled:
             self.recorder.stamp(time.perf_counter())
+        if self.lifecycles:
+            now = time.perf_counter()
+            for lc in self.lifecycles.values():
+                lc.stamp(now)
+            self._note_txn_latency()
 
     def _note_era_gap(self) -> None:
         """Stamp the round-9 era-cutover gauges after each epoch: the
@@ -568,6 +627,87 @@ class SimNetwork:
         elif len(self._steady_durations) < 4096:
             self._steady_durations.append(dur)
         self.metrics.gauge("shadow_dkg_stall_epochs").track(stall)
+
+    def _note_txn_latency(self) -> None:
+        """Per-epoch latency bookkeeping: mirror the cross-node e2e
+        sketch merge into the txn_latency_* gauges, mirror lifecycle
+        counts, feed newly committed samples to the SLO tracker, and
+        push any burn-rate violation LOUDLY into the fault ring — a
+        breached SLO must fail scenario runs the way a silently
+        tolerated fault does."""
+        merged = LatencySketch()
+        submitted = resubmitted = committed = 0
+        for lc in self.lifecycles.values():
+            merged.merge(lc.sketches["e2e"])
+            submitted += lc.submitted
+            resubmitted += lc.resubmitted
+            committed += lc.committed_count
+        # lifetime values mirrored with set, not inc (the meter_bytes
+        # idiom): the lifecycles already hold the cumulative truth
+        self.metrics.counter(M.TXN_SUBMITTED).value = submitted
+        self.metrics.counter(M.TXN_COMMITTED).value = committed
+        if merged.count:
+            pcts = merged.percentiles()
+            self.metrics.gauge(M.TXN_LATENCY_P50_S).track(round(pcts["p50"], 6))
+            self.metrics.gauge(M.TXN_LATENCY_P90_S).track(round(pcts["p90"], 6))
+            self.metrics.gauge(M.TXN_LATENCY_P99_S).track(round(pcts["p99"], 6))
+            self.metrics.gauge(M.TXN_LATENCY_P999_S).track(
+                round(pcts["p999"], 6)
+            )
+        if self.slo_tracker is None:
+            return
+        for nid, lc in self.lifecycles.items():
+            start = self._slo_cursor.get(nid, 0)
+            for v in lc.samples[start:]:
+                self.slo_tracker.observe(v)
+            self._slo_cursor[nid] = len(lc.samples)
+        msg = self.slo_tracker.check()
+        if msg is not None:
+            self.metrics.counter(M.SLO_VIOLATIONS).inc()
+            self.router.faults.append(("slo", Fault("slo", msg)))
+
+    def span_sketches(self) -> Dict[str, LatencySketch]:
+        """Cross-node merge of every lifecycle span sketch (e2e,
+        admission, propose_wait, consensus) — fresh objects, the
+        per-node state is never mutated."""
+        merged: Dict[str, LatencySketch] = {}
+        for lc in self.lifecycles.values():
+            for name, sp in lc.sketches.items():
+                agg = merged.get(name)
+                if agg is None:
+                    agg = merged[name] = LatencySketch(sp.rel_err)
+                agg.merge(sp)
+        return merged
+
+    def txn_latency_snapshot(self) -> dict:
+        """The row-embeddable latency field soak/bench carry: merged
+        e2e percentiles (seconds) + lifecycle counts."""
+        if not self.lifecycles:
+            return {}
+        merged = self.span_sketches().get("e2e")
+        if merged is None or not merged.count:
+            return {}
+        out = {
+            k: round(v, 6)
+            for k, v in merged.percentiles().items()
+            if v is not None
+        }
+        out["count"] = merged.count
+        out["submitted"] = sum(
+            lc.submitted for lc in self.lifecycles.values()
+        )
+        out["resubmitted"] = sum(
+            lc.resubmitted for lc in self.lifecycles.values()
+        )
+        return out
+
+    def exact_e2e_samples(self) -> List[float]:
+        """Every node's exact retained e2e samples — the ground truth
+        bench config 17's sketch-error assertion compares against."""
+        out: List[float] = []
+        for lc in self.lifecycles.values():
+            out.extend(lc.samples)
+        return out
 
     def steady_epoch_p50(self) -> float:
         """Median steady-state epoch wall (no live keygen, no era flip)
@@ -643,6 +783,9 @@ class SimNetwork:
             if unwrap is not None:
                 node = unwrap()
             objs.extend(node_objects(node))
+        # the latency plane's own ledgers ride the census: the plane
+        # that watches for leaks must be provably flat itself
+        objs.extend(self.lifecycles.values())
         self.census.sample(objs, label=len(self.epoch_durations))
 
     def _run_epoch_inner(self) -> None:
@@ -655,12 +798,27 @@ class SimNetwork:
             return
         if cfg.protocol == "qhb":
             for nid in self.ids:
+                lc = self.lifecycles.get(nid)
                 for _ in range(cfg.txns_per_node_per_epoch):
-                    self.nodes[nid].push_transaction(self._gen_txn())
+                    txn = self._gen_txn()
+                    # submission is stamped PER TXN at enqueue — the
+                    # old batch-granularity stamp erased queueing delay
+                    # from sim-tier latency; a deduplicated resubmission
+                    # keeps the original's stamp and counts separately
+                    if lc is not None and not lc.submit(
+                        txn_id(txn), time.perf_counter()
+                    ):
+                        self.metrics.counter(M.TXN_RESUBMITTED).inc()
+                    self.nodes[nid].push_transaction(txn)
+                if lc is not None:
+                    lc.stamp(time.perf_counter())  # admitted notes
             for nid in self.ids:
                 self.router.dispatch_step(
                     nid, self.nodes[nid].force_propose(self.rng)
                 )
+                lc = self.lifecycles.get(nid)
+                if lc is not None:
+                    lc.stamp(time.perf_counter())  # proposed notes
         else:
             for nid in self.ids:
                 node = self.nodes[nid]
@@ -721,6 +879,7 @@ class SimNetwork:
             m.latency_p50_ms = pct(0.50)
             m.latency_p90_ms = pct(0.90)
             m.latency_p99_ms = pct(0.99)
+        m.txn_latency = self.txn_latency_snapshot()
         for batch in self._batches(honest[0]):
             for _, txns in sorted(batch.contributions.items()):
                 if isinstance(txns, (list, tuple)):

@@ -98,6 +98,11 @@ class Router:
         # queue depth (the sim analogue of the TCP handler queue)
         self.obs = _resolve_recorder(recorder)
         self.metrics = metrics
+        # txn-lifecycle ledgers (obs/latency.py), node id -> TxnLifecycle,
+        # installed wholesale by the owning network: the delivery loop is
+        # the sim's rx I/O boundary, so it stamps the recipient's buffered
+        # lifecycle notes with the same clock read the recorder gets
+        self.lifecycles: Dict[Any, Any] = {}
         # container by mode: a list supports the O(1) swap-pop random
         # pick shuffle needs; a deque supports the O(1) popleft FIFO
         # needs.  (deque.rotate for the random pick was O(queue) per
@@ -296,8 +301,16 @@ class Router:
             self.dispatch_step(recipient, step)
         if self.metrics is not None:
             self.metrics.gauge("router_queue_depth").track(len(self.queue))
-        if self.obs.enabled:
-            self.obs.stamp(time.perf_counter())
+        if self.obs.enabled or self.lifecycles:
+            now = time.perf_counter()
+            if self.obs.enabled:
+                self.obs.stamp(now)
+            # notes buffered by the recipient's core during this
+            # delivery (admitted/proposed/committed) resolve to the
+            # same boundary moment the trace events get
+            lc = self.lifecycles.get(recipient)
+            if lc is not None:
+                lc.stamp(now)
         return True
 
     def run(self, max_messages: int = 1_000_000) -> int:

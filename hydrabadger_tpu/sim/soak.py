@@ -111,6 +111,7 @@ def sim_soak(epochs: int = 1000, n_nodes: int = 16,
         "device_overlap_ratio_raw": overlap["device_overlap_ratio_raw"],
         "device_backend": overlap["device_backend"],
         "device_idle_s": overlap["device_idle_s"],
+        "txn_latency": net.txn_latency_snapshot(),
         "metrics": net.metrics.snapshot(),
         "agreement_ok": m.agreement_ok,
     }
@@ -190,6 +191,7 @@ def byz_soak(epochs: int = 200, n_nodes: int = 4,
     # silent tolerance fails the tier (also folds fault_log counts
     # into the byz_faults_* counters the row carries)
     net.verify_scenario()
+    txn_latency = net.txn_latency_snapshot()
     net.shutdown()
     counters = net.metrics.snapshot()["counters"]
     f = n_nodes - len(net.honest_ids)
@@ -209,6 +211,7 @@ def byz_soak(epochs: int = 200, n_nodes: int = 4,
             k: v for k, v in sorted(counters.items())
             if k.startswith("byz_faults_")
         },
+        "txn_latency": txn_latency,
         "agreement_ok": True,
         "metrics": net.metrics.snapshot(),
     }
@@ -474,6 +477,13 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
         # fold every node's registry into one snapshot row: counters
         # sum, gauges take the worst node (high-water semantics)
         merged = _merge_metrics([m.metrics.snapshot() for m in nodes])
+        # cross-node submit->commit latency: per-node sketches merge
+        # (honest clocks here, so no rate correction needed)
+        from ..obs.latency import merge_sketch_dicts
+
+        e2e = merge_sketch_dicts(
+            [m.txn_lifecycle.sketch_feed() for m in nodes]
+        ).get("e2e")
         for m in nodes:
             await m.stop()
         epochs_done = min(committed)
@@ -498,6 +508,11 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
             "device_overlap_ratio_raw": overlap["device_overlap_ratio_raw"],
             "device_backend": overlap["device_backend"],
             "device_idle_s": overlap["device_idle_s"],
+            "txn_latency": {
+                "count": e2e.count if e2e else 0,
+                "p50_s": round(e2e.quantile(0.5), 6) if e2e else None,
+                "p99_s": round(e2e.quantile(0.99), 6) if e2e else None,
+            },
             "metrics": merged,
         }
 
@@ -506,7 +521,11 @@ def tcp_soak(epochs: int = 1000, rss_budget_mb: float = 256.0) -> Dict:
 
 def _merge_metrics(snapshots: List[Dict]) -> Dict:
     """Fold per-node registry snapshots: counters sum, gauges keep the
-    worst (value AND high_water), histograms add bucket counts."""
+    worst (value AND high_water), histograms add bucket counts and
+    merge the sketch backing so the folded p50/p99 are real quantiles
+    of the union, not a max-of-maxes."""
+    from ..obs.latency import LatencySketch
+
     out: Dict = {"counters": {}, "gauges": {}, "histograms": {}}
     for snap in snapshots:
         for k, v in snap.get("counters", {}).items():
@@ -525,6 +544,12 @@ def _merge_metrics(snapshots: List[Dict]) -> Dict:
                 ]
                 cur["total"] += h["total"]
                 cur["sum"] = round(cur["sum"] + h["sum"], 6)
+                if "sketch" in cur and "sketch" in h:
+                    folded = LatencySketch.from_dict(cur["sketch"])
+                    folded.merge(LatencySketch.from_dict(h["sketch"]))
+                    cur["sketch"] = folded.to_dict()
+                    cur["p50"] = round(folded.quantile(0.5), 6)
+                    cur["p99"] = round(folded.quantile(0.99), 6)
     return out
 
 
@@ -622,6 +647,76 @@ def rbc_soak(epochs: int = 5, n_nodes: int = 16) -> Dict:
     }
 
 
+def slo_soak(epochs: int = 10, n_nodes: int = 4) -> Dict:
+    """Latency-SLO gate (the txn-latency plane's CI teeth): two short
+    qhb sim legs exercising both sides of the SLO contract.
+
+      * HONEST leg, generous SLO (p99 < 5 s): asserts the plane
+        measures real submit->commit latency without false positives —
+        a violation here means the threshold machinery is broken, not
+        the cluster.
+      * CHAOS leg, strict SLO (p90 < 0.1 ms) under the PR 7 attack
+        catalog: a target the attacked cluster cannot meet, so the
+        violation path MUST fire — burn-rate tracker, slo_violations
+        counter, and the LOUD fault-ring entry are all asserted.  A
+        regression that silently swallows violations fails here, not
+        in production dashboards.
+    """
+    from ..obs.latency import SloSpec
+    from .network import SimConfig, SimNetwork
+    from .scenario import attack_spec
+
+    def leg(scenario, spec):
+        net = SimNetwork(
+            SimConfig(
+                n_nodes=n_nodes, protocol="qhb", encrypt=True,
+                verify_shares=True, txns_per_node_per_epoch=5,
+                txn_bytes=8, seed=23, scenario=scenario, slo=spec,
+            )
+        )
+        m = net.run(epochs)
+        assert m.agreement_ok, "slo gate lost agreement"
+        row = net.txn_latency_snapshot()
+        counters = net.metrics.snapshot()["counters"]
+        ring = [
+            f.kind for _n, f in net.router.faults
+            if f.kind.startswith("slo violation")
+        ]
+        net.shutdown()
+        return row, counters.get("slo_violations", 0), ring
+
+    honest, h_violations, h_ring = leg(
+        None, SloSpec(percentile=0.99, threshold_s=5.0, min_samples=8)
+    )
+    assert honest["count"] > 0, "slo gate honest leg measured nothing"
+    assert h_violations == 0 and not h_ring, (
+        f"honest load tripped the SLO ({h_violations} violations): "
+        "either the cluster is pathologically slow or the threshold "
+        "machinery is firing falsely"
+    )
+
+    chaos, c_violations, c_ring = leg(
+        attack_spec(n_nodes, seed=23),
+        SloSpec(percentile=0.9, threshold_s=1e-4, min_samples=8),
+    )
+    assert c_violations > 0, (
+        "chaos leg met a 0.1 ms p90 target — the SLO violation path "
+        "cannot be firing"
+    )
+    assert c_ring and "burn rate" in c_ring[0], (
+        f"violations counted but the fault ring stayed quiet: {c_ring!r}"
+    )
+    return {
+        "tier": f"slo_gate_{n_nodes}node",
+        "epochs": epochs,
+        "honest": dict(honest, slo_violations=h_violations),
+        "chaos": dict(
+            chaos, slo_violations=c_violations, ring_sample=c_ring[0]
+        ),
+        "agreement_ok": True,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -668,6 +763,14 @@ def main(argv=None) -> int:
                    "dumps) so the scripts/test-all aggregate gate can "
                    "run obs.aggregate over it afterwards; default: a "
                    "fresh tempdir")
+    p.add_argument("--slo-only", action="store_true",
+                   help="run ONLY the latency-SLO gate (honest leg "
+                   "green under a generous SLO, chaos leg proving the "
+                   "violation path fires loudly; a scripts/test-all "
+                   "gate)")
+    p.add_argument("--skip-slo", action="store_true")
+    p.add_argument("--slo-epochs", type=int, default=10,
+                   help="epochs per SLO-gate leg (two legs)")
     p.add_argument("--rbc-only", action="store_true",
                    help="run ONLY the bandwidth-metered RBC variant "
                    "gate (point-identical batches + bytes/epoch delta "
@@ -685,6 +788,7 @@ def main(argv=None) -> int:
         or args.era_only
         or args.proc_only
         or args.rbc_only
+        or args.slo_only
     )
     if args.rbc_only or (not only and not args.skip_rbc):
         r = rbc_soak(args.rbc_epochs)
@@ -698,14 +802,20 @@ def main(argv=None) -> int:
         r = era_soak(args.era_nodes)
         print(json.dumps(r), flush=True)
         results.append(r)
+    if args.slo_only or (not only and not args.skip_slo):
+        r = slo_soak(args.slo_epochs)
+        print(json.dumps(r), flush=True)
+        results.append(r)
     if not args.skip_byz and not (
         args.wire_only or args.era_only or args.proc_only or args.rbc_only
+        or args.slo_only
     ):
         r = byz_soak(args.byz_epochs or max(20, args.epochs // 5))
         print(json.dumps(r), flush=True)
         results.append(r)
     if not args.skip_wire and not (
         args.byz_only or args.era_only or args.proc_only or args.rbc_only
+        or args.slo_only
     ):
         r = wire_chaos_soak(args.wire_epochs)
         print(json.dumps(r), flush=True)

@@ -1,0 +1,367 @@
+"""Txn-latency plane tests: the DDSketch error bound on adversarial
+distributions, merge algebra (commutative/associative, equals
+whole-stream), bounded memory under 10^6 inserts, lifecycle span
+resolution end to end on a 4-node sim (stage decomposition summing
+within 10% of measured submit->commit), the SLO burn-rate violation
+path, and the clock-alignment contract (skew offsets cancel inside
+durations, drift rates undo via scale before merge)."""
+import math
+import random
+
+import pytest
+
+from hydrabadger_tpu.obs.latency import (
+    DEFAULT_MAX_BUCKETS,
+    LatencySketch,
+    SloSpec,
+    SloTracker,
+    TxnLifecycle,
+    exact_quantile,
+    merge_sketch_dicts,
+    txn_id,
+)
+
+pytestmark = pytest.mark.slo
+
+QS = (0.5, 0.9, 0.99, 0.999)
+
+
+def _assert_within_rel_err(samples, sketch, slack=1.5):
+    """Every quantile within the sketch's advertised relative error
+    (x slack: the guarantee is per-bucket; clamping and the nearest-
+    rank convention can add a fraction of a bucket at cluster edges)."""
+    for q in QS:
+        approx = sketch.quantile(q)
+        truth = exact_quantile(samples, q)
+        assert truth is not None and approx is not None
+        if truth <= 1e-9:
+            assert approx <= 1e-9
+            continue
+        err = abs(approx - truth) / truth
+        assert err <= sketch.rel_err * slack, (
+            f"q={q}: sketch {approx} vs exact {truth} ({err:.2%})"
+        )
+
+
+# -- the error bound on adversarial distributions ----------------------------
+
+
+def test_sketch_error_bound_heavy_tail():
+    rng = random.Random(1)
+    samples = [rng.lognormvariate(0.0, 2.0) for _ in range(100_000)]
+    sk = LatencySketch()
+    for v in samples:
+        sk.add(v)
+    _assert_within_rel_err(samples, sk)
+
+
+def test_sketch_error_bound_bimodal_clusters():
+    # two tight clusters five decades apart — the shape that breaks
+    # fixed-bucket histograms (everything lands in two bins)
+    rng = random.Random(2)
+    samples = [rng.gauss(1e-4, 1e-6) for _ in range(5000)]
+    samples += [rng.gauss(10.0, 0.1) for _ in range(5000)]
+    samples = [abs(v) for v in samples]
+    sk = LatencySketch()
+    for v in samples:
+        sk.add(v)
+    _assert_within_rel_err(samples, sk)
+
+
+def test_sketch_error_bound_geometric_sweep():
+    # one sample per 1% step across 12 decades: every sample its own
+    # bucket, maximum index spread
+    samples = [1e-6 * 1.01 ** i for i in range(2780)]
+    sk = LatencySketch()
+    for v in samples:
+        sk.add(v)
+    _assert_within_rel_err(samples, sk)
+
+
+def test_sketch_error_bound_duplicates_and_zeros():
+    samples = [0.0] * 100 + [0.25] * 900
+    sk = LatencySketch()
+    for v in samples:
+        sk.add(v)
+    assert sk.quantile(0.05) == 0.0  # zero bucket ranks first
+    _assert_within_rel_err(samples, sk)
+
+
+# -- merge algebra ------------------------------------------------------------
+
+
+def _sketch_of(values):
+    sk = LatencySketch()
+    for v in values:
+        sk.add(v)
+    return sk
+
+
+def test_merge_commutative_and_associative():
+    rng = random.Random(3)
+    parts = [
+        [rng.expovariate(1.0 / 0.2) for _ in range(2000)]
+        for _ in range(3)
+    ]
+    a_bc = _sketch_of(parts[0])
+    bc = _sketch_of(parts[1])
+    bc.merge(_sketch_of(parts[2]))
+    a_bc.merge(bc)  # a + (b + c)
+
+    ab_c = _sketch_of(parts[0])
+    ab_c.merge(_sketch_of(parts[1]))
+    ab_c.merge(_sketch_of(parts[2]))  # (a + b) + c
+
+    c_ba = _sketch_of(parts[2])
+    c_ba.merge(_sketch_of(parts[1]))
+    c_ba.merge(_sketch_of(parts[0]))  # reversed order
+
+    for other in (ab_c, c_ba):
+        assert a_bc.buckets == other.buckets
+        assert a_bc.count == other.count
+        assert a_bc.zero_count == other.zero_count
+        assert math.isclose(a_bc.sum, other.sum, rel_tol=1e-12)
+        assert a_bc.min == other.min and a_bc.max == other.max
+
+
+def test_merge_equals_whole_stream():
+    rng = random.Random(4)
+    xs = [rng.lognormvariate(-2.0, 1.0) for _ in range(3000)]
+    ys = [rng.lognormvariate(1.0, 0.5) for _ in range(3000)]
+    merged = _sketch_of(xs)
+    merged.merge(_sketch_of(ys))
+    whole = _sketch_of(xs + ys)
+    assert merged.buckets == whole.buckets
+    assert merged.count == whole.count
+    _assert_within_rel_err(xs + ys, merged)
+
+
+def test_merge_rejects_mismatched_rel_err():
+    with pytest.raises(ValueError):
+        LatencySketch(rel_err=0.01).merge(LatencySketch(rel_err=0.02))
+
+
+# -- edges --------------------------------------------------------------------
+
+
+def test_empty_sketch():
+    sk = LatencySketch()
+    assert sk.quantile(0.5) is None
+    assert sk.percentiles() == {
+        "p50": None, "p90": None, "p99": None, "p999": None
+    }
+    d = sk.to_dict()
+    back = LatencySketch.from_dict(d)
+    assert back.count == 0 and back.quantile(0.99) is None
+
+
+def test_single_sample_exact():
+    sk = LatencySketch()
+    sk.add(0.317)
+    # min/max clamping makes every quantile of one sample exact
+    for q in QS:
+        assert sk.quantile(q) == pytest.approx(0.317)
+
+
+def test_roundtrip_preserves_quantiles():
+    rng = random.Random(5)
+    sk = _sketch_of([rng.expovariate(2.0) for _ in range(1000)])
+    back = LatencySketch.from_dict(sk.to_dict())
+    for q in QS:
+        assert back.quantile(q) == pytest.approx(sk.quantile(q))
+    assert back.buckets == sk.buckets
+
+
+# -- bounded memory -----------------------------------------------------------
+
+
+def test_bounded_memory_under_1e6_inserts():
+    # a million inserts across ~15 decades: unbounded DDSketch would
+    # mint ~1600 buckets.  The default bound never collapses here
+    # (full accuracy everywhere); a deliberately tight 512-bucket
+    # sketch must stay bounded while keeping the TAIL (p999)
+    # accurate — collapse-lowest sacrifices the head by design
+    sk = LatencySketch()
+    tight = LatencySketch(max_buckets=512)
+    rng = random.Random(6)
+    samples = []
+    for i in range(1_000_000):
+        v = rng.lognormvariate(0.0, 4.0)
+        samples.append(v)
+        sk.add(v)
+        tight.add(v)
+    assert len(sk.buckets) <= DEFAULT_MAX_BUCKETS
+    assert len(tight.buckets) <= 512
+    assert sk.count == tight.count == 1_000_000
+    _assert_within_rel_err(samples, sk)
+    truth = exact_quantile(samples, 0.999)
+    assert abs(tight.quantile(0.999) - truth) / truth <= tight.rel_err * 1.5
+
+
+# -- clock alignment ----------------------------------------------------------
+
+
+def _lifecycle_run(clock, durations):
+    """Drive one submit->...->committed cycle per duration through a
+    TxnLifecycle, reading every boundary stamp from ``clock(t)``."""
+    lc = TxnLifecycle()
+    for i, d in enumerate(durations):
+        tid = txn_id(b"txn-%d" % i)
+        base = 100.0 + 10.0 * i
+        assert lc.submit(tid, clock(base))
+        lc.note_stage(tid, "admitted")
+        lc.stamp(clock(base + 0.25 * d))
+        lc.note_stage(tid, "proposed")
+        lc.stamp(clock(base + 0.40 * d))
+        lc.note_stage(tid, "committed")
+        lc.stamp(clock(base + d))
+    return lc
+
+
+def test_skew_offset_cancels_in_latency():
+    # PR 10 clock chaos, offset half: a +30 s skewed wall clock reads
+    # every boundary late by the same constant — durations, and so
+    # every percentile, must come out identical to the honest run
+    durations = [0.1 * (i + 1) for i in range(20)]
+    honest = _lifecycle_run(lambda t: t, durations)
+    skewed = _lifecycle_run(lambda t: t + 30.0, durations)
+    assert skewed.sketches["e2e"].buckets == honest.sketches["e2e"].buckets
+    for q in QS:
+        assert skewed.sketches["e2e"].quantile(q) == pytest.approx(
+            honest.sketches["e2e"].quantile(q)
+        )
+
+
+def test_drift_rate_undone_by_aligned_merge():
+    # drift half: a clock running 1.25x fast stretches every duration
+    # by 1.25 — the aggregator's rate correction (scale(1/rate) before
+    # merge, via merge_sketch_dicts) must restore the honest numbers
+    durations = [0.05 * (i + 1) for i in range(40)]
+    honest = _lifecycle_run(lambda t: t, durations)
+    drifted = _lifecycle_run(lambda t: 30.0 + t * 1.25, durations)
+    raw = drifted.sketches["e2e"].quantile(0.5)
+    assert raw == pytest.approx(
+        1.25 * honest.sketches["e2e"].quantile(0.5), rel=0.03
+    )
+    merged = merge_sketch_dicts(
+        [dict(drifted.sketch_feed(), node="2")], {"2": 1.25}
+    )
+    for q in QS:
+        assert merged["e2e"].quantile(q) == pytest.approx(
+            honest.sketches["e2e"].quantile(q), rel=0.03
+        )
+
+
+# -- lifecycle ledger hygiene -------------------------------------------------
+
+
+def test_resubmission_dedup_does_not_restamp():
+    lc = TxnLifecycle()
+    tid = txn_id(b"dup")
+    assert lc.submit(tid, 1.0)
+    assert not lc.submit(tid, 5.0)  # dedup: original stamp survives
+    assert lc.resubmitted == 1
+    lc.note_stage(tid, "committed")
+    lc.stamp(9.0)
+    assert lc.sketches["e2e"].quantile(0.5) == pytest.approx(8.0)
+
+
+def test_pending_lru_bounded():
+    lc = TxnLifecycle(max_pending=8)
+    for i in range(32):
+        lc.submit(txn_id(b"p%d" % i), float(i))
+    assert len(lc.pending) == 8
+    assert lc.evicted_pending == 24
+
+
+def test_foreign_commit_resolves_to_nothing():
+    lc = TxnLifecycle()
+    lc.note_stage(txn_id(b"not-mine"), "committed")
+    assert lc.stamp(1.0) == 0
+    assert lc.committed_count == 0
+
+
+# -- SLO burn rate ------------------------------------------------------------
+
+
+def test_slo_green_below_threshold():
+    tr = SloTracker(SloSpec(percentile=0.99, threshold_s=1.0, min_samples=4))
+    for _ in range(64):
+        tr.observe(0.2)
+    assert tr.check() is None
+    assert tr.violations == 0
+
+
+def test_slo_violation_fires_loudly():
+    tr = SloTracker(SloSpec(percentile=0.99, threshold_s=0.1, min_samples=4))
+    msg = None
+    for _ in range(16):
+        tr.observe(0.5)
+        msg = tr.check() or msg
+    assert msg is not None and msg.startswith("slo violation:")
+    assert "burn rate" in msg
+    assert tr.violations > 0
+
+
+def test_slo_min_samples_gates_verdict():
+    tr = SloTracker(SloSpec(threshold_s=0.1, min_samples=10))
+    for _ in range(9):
+        tr.observe(9.9)  # way over, but not enough evidence yet
+        assert tr.check() is None
+
+
+# -- histogram re-backing (the config-12 "p99 > 60 s is not a number") -------
+
+
+def test_histogram_sketch_backed_tail_is_real():
+    from hydrabadger_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("epoch_duration_s", edges=(0.1, 1.0, 60.0))
+    for _ in range(95):
+        h.observe(0.5)
+    for _ in range(5):
+        h.observe(80.0)  # beyond the last edge: fixed buckets say ">60"
+    p99 = h.quantile(0.99)
+    assert p99 is not None and abs(p99 - 80.0) / 80.0 <= 0.02
+    snap = reg.snapshot()["histograms"]["epoch_duration_s"]
+    # schema strictly additive: old fixed-edge keys intact, sketch new
+    assert snap["counts"][-1] == 5 and snap["total"] == 100
+    assert snap["p99"] == pytest.approx(p99, rel=1e-6)
+    back = LatencySketch.from_dict(snap["sketch"])
+    assert back.count == 100
+
+
+# -- end to end: 4-node sim, stage decomposition pin -------------------------
+
+
+@pytest.mark.slow
+def test_sim_stage_decomposition_sums_to_e2e():
+    from hydrabadger_tpu.sim.network import SimConfig, SimNetwork
+
+    net = SimNetwork(
+        SimConfig(n_nodes=4, protocol="qhb", txns_per_node_per_epoch=5,
+                  txn_bytes=8, seed=13, native_acs=False)
+    )
+    m = net.run(4)
+    assert m.agreement_ok
+    snap = net.txn_latency_snapshot()
+    assert snap["count"] == snap["submitted"] > 0
+    spans = net.span_sketches()
+    stage_sum = sum(
+        spans[s].sum for s in ("admission", "propose_wait", "consensus")
+    )
+    e2e = spans["e2e"].sum
+    assert e2e > 0
+    # each txn's stage spans partition its lifetime; the sums must
+    # agree within 10% (exactly, absent dropped stage notes)
+    assert abs(stage_sum - e2e) / e2e <= 0.10
+    # sketch percentiles within 2% of the exact samples the sim retains
+    exact = net.exact_e2e_samples()
+    for q in (0.5, 0.99):
+        truth = exact_quantile(exact, q)
+        assert abs(spans["e2e"].quantile(q) - truth) / truth <= 0.02
+    # ledger hygiene: every submitted txn committed, nothing pinned
+    assert all(not lc.pending for lc in net.lifecycles.values())
+    assert all(not lc._notes for lc in net.lifecycles.values())
+    net.shutdown()
